@@ -1,0 +1,46 @@
+"""dopt — a TPU-native distributed-optimization and federated-learning framework.
+
+``dopt`` re-creates the full capability surface of the reference project
+"Distributed-Optimization-and-Learning" (two PyTorch single-process
+simulations of federated and gossip learning) as a real distributed
+framework designed for TPUs:
+
+* Workers are *devices* (or vmapped lanes folded onto devices) on a
+  ``jax.sharding.Mesh`` rather than sequentially-stepped Python objects.
+* Model/optimizer/dual state for all N workers lives in one *stacked
+  pytree* (leading worker axis) sharded across the mesh.
+* Gossip consensus (weighted neighbor averaging with a mixing matrix) is
+  an XLA collective: ``lax.ppermute`` chains for banded topologies,
+  ``all_gather`` + einsum for dense/arbitrary graphs.
+* Federated aggregation (FedAvg / FedProx / FedADMM) is a masked
+  ``lax.psum`` over the worker axis with client-sampling masks.
+* A faithful torch-CPU oracle backend reproduces the reference's exact
+  numerics (including its quirks, e.g. the double-softmax head) so the
+  TPU path can be validated step-by-step.
+
+Reference layer map: see SURVEY.md §1 in the repository root.
+"""
+
+from dopt.config import (
+    DataConfig,
+    ExperimentConfig,
+    FederatedConfig,
+    GossipConfig,
+    ModelConfig,
+    OptimizerConfig,
+)
+from dopt.topology import MixingMatrices, Topology, build_mixing_matrices
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataConfig",
+    "ExperimentConfig",
+    "FederatedConfig",
+    "GossipConfig",
+    "ModelConfig",
+    "OptimizerConfig",
+    "MixingMatrices",
+    "Topology",
+    "build_mixing_matrices",
+]

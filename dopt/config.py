@@ -1,0 +1,219 @@
+"""Typed, frozen experiment configuration.
+
+The reference drives everything through a ``DotDict`` built from a Colab
+form cell (``Decentralized Optimization/src/utils.py:14-27`` and the
+notebook config cells); missing keys silently read as ``None`` and
+several orchestrators mutate the shared args object
+(``Distributed Optimization/src/simulators.py:171-180``).  ``dopt``
+replaces that with frozen dataclasses while keeping the reference's
+parameter *names* (num_users, frac, local_ep, local_bs, lr, momentum,
+rho, topology, mode, shards, iid, seed) so every published experiment
+config maps 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset selection + partitioning (reference ``get_dataset`` args)."""
+
+    dataset: str = "mnist"  # mnist | fmnist | cifar10 | cifar100 | synthetic | a9a
+    iid: bool = True
+    shards: int = 2          # non-IID shards per user (P2 sampling.py:11-28)
+    num_users: int = 8
+    data_dir: str | None = None   # directory with raw files; None -> auto/synthetic
+    synthetic_train_size: int = 2048
+    synthetic_test_size: int = 512
+    unequal: bool = False
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model zoo selection (reference ``args.model`` string dispatch)."""
+
+    model: str = "model1"    # model1 | model3 | mlp | resnet18 | logistic
+    faithful_head: bool = True
+    # faithful_head=True reproduces the reference's Softmax-head +
+    # CrossEntropyLoss double-softmax (models.py:22-27 + clients.py:11);
+    # False uses the corrected logits head.
+    num_classes: int = 10
+    input_shape: tuple[int, ...] = (28, 28, 1)   # NHWC (TPU-native layout)
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"   # "bfloat16" for the fast path
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Local SGD settings (reference ``clients.py`` optimizer construction)."""
+
+    optimizer: str = "sgd"
+    lr: float = 0.01
+    momentum: float = 0.5
+    weight_decay: float = 0.0
+    rho: float = 0.1   # FedProx proximal weight / FedADMM penalty
+
+
+@dataclass(frozen=True)
+class FederatedConfig:
+    """Server-coordinated path (reference P1 ``servers.py``)."""
+
+    algorithm: str = "fedavg"   # fedavg | fedprox | fedadmm
+    frac: float = 0.1           # fraction of users sampled per round
+    rounds: int = 20
+    local_ep: int = 10
+    local_bs: int = 50
+
+
+@dataclass(frozen=True)
+class GossipConfig:
+    """Serverless gossip/consensus path (reference P2 ``simulators.py``)."""
+
+    algorithm: str = "dsgd"     # dsgd | nocons | centralized | fedlcon | gossip
+    topology: str = "circle"    # circle | star | complete | dynamic | random
+    mode: str = "stochastic"    # stochastic | double_stochastic | metropolis | uniform | ones
+    rounds: int = 10
+    local_ep: int = 4
+    local_bs: int = 128
+    eps: int = 1                # consensus sweeps per round (FedLCon)
+    faithful_bugs: bool = False
+    # faithful_bugs=True replicates documented reference bugs (FedLCon's
+    # stale new_weights accumulation, simulators.py:189-196) for oracle
+    # comparison; the idiomatic path fixes them.
+    self_weight: bool = False   # reference mixing has zero diagonal (SURVEY §6.2)
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level experiment description = the notebook form cell, typed."""
+
+    name: str = "experiment"
+    seed: int = 2022
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimizerConfig = field(default_factory=OptimizerConfig)
+    federated: FederatedConfig | None = None
+    gossip: GossipConfig | None = None
+    # Execution backend: "jax" (TPU/mesh path) or "torch" (faithful CPU oracle).
+    backend: str = "jax"
+    # Mesh shape: workers are folded onto devices; workers_per_device>1
+    # vmaps multiple worker lanes onto one chip (SURVEY §7 hard parts).
+    mesh_devices: int | None = None   # None -> all available
+
+    def replace(self, **kw: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def num_users(self) -> int:
+        return self.data.num_users
+
+
+def _filter_kwargs(cls: type, d: Mapping[str, Any]) -> dict[str, Any]:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in d.items() if k in names}
+
+
+def from_reference_args(args: Mapping[str, Any]) -> ExperimentConfig:
+    """Build an ``ExperimentConfig`` from a reference-style flat args dict.
+
+    Accepts the exact key names the reference notebooks use (cells 8/11:
+    num_users, local_ep, local_bs, lr, momentum, model, dataset, iid,
+    shards, rho, seed, topology, mode, frac, rounds, eps) so published
+    experiment dictionaries can be replayed verbatim.
+    """
+    def _get(key: str, default):
+        v = args.get(key)
+        return default if v is None else v
+
+    model_name = str(_get("model", "")).lower()
+    dataset = str(_get("dataset", "mnist")).lower()
+    num_classes = 10
+    if dataset in ("cifar", "cifar10"):
+        dataset = "cifar10"
+        input_shape: tuple[int, ...] = (32, 32, 3)
+        default_model = "model3"
+    elif dataset == "cifar100":
+        input_shape = (32, 32, 3)
+        default_model = "model3"
+        num_classes = 100
+    elif dataset == "a9a":
+        input_shape = (123,)   # LIBSVM a9a: 123 binary features, 2 classes
+        default_model = "logistic"
+        num_classes = 2
+    elif dataset == "synthetic":
+        input_shape = tuple(_get("input_shape", (28, 28, 1)))
+        default_model = "mlp"
+    else:
+        input_shape = (28, 28, 1)
+        default_model = "model1"
+    if model_name in ("", "none"):
+        model_name = default_model
+
+    data = DataConfig(
+        dataset=dataset,
+        iid=bool(_get("iid", True)),
+        shards=int(_get("shards", 2)),
+        num_users=int(_get("num_users", 8)),
+        data_dir=args.get("data_dir"),
+        unequal=bool(_get("unequal", False)),
+    )
+    model = ModelConfig(
+        model=model_name,
+        num_classes=num_classes,
+        input_shape=input_shape,
+        faithful_head=bool(_get("faithful_head", True)),
+    )
+    optim = OptimizerConfig(
+        lr=float(_get("lr", 0.01)),
+        momentum=float(_get("momentum", 0.5)),
+        rho=float(_get("rho", 0.1)),
+        optimizer=str(_get("optimizer", "sgd")),
+    )
+    federated = None
+    gossip = None
+    # Reference DotDict form cells carry unused keys with value None;
+    # route on a *usable* topology value, not key presence.
+    if args.get("topology") or str(_get("paradigm", "")) == "gossip":
+        gossip = GossipConfig(
+            algorithm=str(_get("algorithm", "dsgd")),
+            topology=str(_get("topology", "circle")),
+            mode=str(_get("mode", "stochastic")),
+            rounds=int(_get("rounds", 10)),
+            local_ep=int(_get("local_ep", 4)),
+            local_bs=int(_get("local_bs", 128)),
+            eps=int(_get("eps", 1)),
+        )
+    else:
+        federated = FederatedConfig(
+            algorithm=str(_get("algorithm", "fedavg")),
+            frac=float(_get("frac", 0.1)),
+            rounds=int(_get("rounds", 20)),
+            local_ep=int(_get("local_ep", 10)),
+            local_bs=int(_get("local_bs", 50)),
+        )
+    return ExperimentConfig(
+        name=str(args.get("name", "experiment")),
+        seed=int(args.get("seed", 2022)),
+        data=data,
+        model=model,
+        optim=optim,
+        federated=federated,
+        gossip=gossip,
+    )
+
+
+def exp_details(cfg: ExperimentConfig) -> str:
+    """Human-readable config dump (reference ``exp_details``, utils.py:147-165)."""
+    lines = [f"Experiment: {cfg.name}", f"  seed      : {cfg.seed}", f"  backend   : {cfg.backend}"]
+    for section in ("data", "model", "optim", "federated", "gossip"):
+        sub = getattr(cfg, section)
+        if sub is None:
+            continue
+        lines.append(f"  [{section}]")
+        for f in dataclasses.fields(sub):
+            lines.append(f"    {f.name:12s}: {getattr(sub, f.name)}")
+    return "\n".join(lines)

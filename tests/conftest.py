@@ -1,0 +1,30 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+This is the TPU-world answer to "test distributed without a cluster"
+(SURVEY §4): jax's ``--xla_force_host_platform_device_count`` gives N
+fake devices on the host, so every mesh/collective codepath runs under
+pytest exactly as it would on an N-chip slice.
+
+Note the axon sitecustomize pins ``jax_platforms`` to the TPU tunnel at
+interpreter startup; ``jax.config.update`` after import wins, and must
+happen before any backend is initialised.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,146 @@
+"""Mixing-matrix invariants (reference semantics: simulators.py:40-86)."""
+
+import numpy as np
+import pytest
+
+from dopt.topology import (
+    MixingMatrices,
+    build_adjacency,
+    build_mixing_matrices,
+    shift_decomposition,
+)
+
+
+@pytest.mark.parametrize("topology", ["circle", "star", "complete", "dynamic"])
+def test_adjacency_zero_diagonal_and_symmetry(topology):
+    for g in build_adjacency(topology, 6):
+        assert np.all(np.diag(g) == 0), "reference adjacency has zero diagonal"
+        assert np.array_equal(g, g.T)
+
+
+def test_circle_is_ring():
+    (g,) = build_adjacency("circle", 5)
+    for i in range(5):
+        assert g[i, (i + 1) % 5] == 1 and g[i, (i - 1) % 5] == 1
+    assert g.sum() == 10
+
+
+def test_star_hub():
+    (g,) = build_adjacency("star", 6)
+    assert g[0].sum() == 5 and np.all(g[1:, 1:] == 0)
+
+
+def test_complete_misspelling_accepted():
+    (g,) = build_adjacency("compelete", 4)  # reference spelling, simulators.py:54
+    assert g.sum() == 12
+
+
+def test_dynamic_schedule_single_edges():
+    graphs = build_adjacency("dynamic", 6)
+    assert len(graphs) == 6
+    for t, g in enumerate(graphs):
+        assert g.sum() == 2
+        assert g[t, (t + 1) % 6] == 1 and g[(t + 1) % 6, t] == 1
+
+
+def test_random_schedule_connected_no_isolated():
+    graphs = build_adjacency("random", 8, p=0.3, schedule_len=5, seed=3)
+    assert len(graphs) == 5
+    for g in graphs:
+        assert np.all(g.sum(axis=1) >= 2), "Hamiltonian cycle guarantees degree >= 2"
+        assert np.all(np.diag(g) == 0)
+
+
+@pytest.mark.parametrize("topology", ["circle", "star", "complete"])
+def test_stochastic_mode_row_stochastic_zero_diag(topology):
+    mm = build_mixing_matrices(topology, "stochastic", 6, seed=1)
+    assert mm.is_row_stochastic()
+    for m in mm.matrices:
+        assert np.all(np.diag(m) == 0), "faithful consensus excludes self (SURVEY §6.2)"
+
+
+@pytest.mark.parametrize("topology", ["circle", "complete"])
+def test_double_stochastic_mode(topology):
+    mm = build_mixing_matrices(topology, "double_stochastic", 6, seed=1)
+    assert mm.is_doubly_stochastic(tol=1e-8)
+    for m in mm.matrices:
+        assert np.all(np.diag(m) == 0)
+
+
+@pytest.mark.parametrize("mode", ["stochastic", "double_stochastic"])
+def test_dynamic_isolated_workers_keep_weights(mode):
+    # Single-edge graphs leave n-2 workers isolated; they must keep their
+    # own weights (identity row), not NaN/zero out like the reference does.
+    mm = build_mixing_matrices("dynamic", mode, 6, seed=1)
+    assert mm.is_row_stochastic()
+    for t, m in enumerate(mm.matrices):
+        edge = {t, (t + 1) % 6}
+        for i in range(6):
+            if i in edge:
+                assert m[i, i] == 0
+            else:
+                assert m[i, i] == 1.0
+
+
+def test_double_stochastic_star_infeasible():
+    # A zero-diagonal doubly-stochastic star matrix does not exist for n>2;
+    # the reference's Sinkhorn loop hangs here (its star/double CSVs are
+    # empty). We raise instead.
+    with pytest.raises(ValueError, match="doubly-stochastic"):
+        build_mixing_matrices("star", "double_stochastic", 6, seed=1)
+
+
+def test_metropolis_doubly_stochastic_with_self_loops():
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    assert mm.is_doubly_stochastic()
+    for m in mm.matrices:
+        assert np.all(np.diag(m) > 0)
+    assert mm.spectral_gap() > 0
+
+
+def test_ones_mode_is_raw_adjacency():
+    mm = build_mixing_matrices("complete", "ones", 4)
+    assert np.array_equal(mm.matrices[0], np.ones((4, 4)) - np.eye(4))
+
+
+def test_self_weight_lazy_gossip():
+    mm = build_mixing_matrices("circle", "stochastic", 6, seed=1, self_weight=True)
+    assert mm.is_row_stochastic()
+    for m in mm.matrices:
+        assert np.all(np.diag(m) == 0.5)
+
+
+def test_for_round_cycles_schedule():
+    mm = build_mixing_matrices("dynamic", "stochastic", 5, seed=0)
+    assert len(mm.matrices) == 5
+    assert np.array_equal(mm.for_round(7), mm.matrices[2])
+
+
+def test_shift_decomposition_ring():
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    shifts = shift_decomposition(mm.matrices[0])
+    shift_ids = sorted(s for s, _ in shifts)
+    assert shift_ids == [0, 1, 7]  # self, +1, -1 (mod 8)
+    # Reconstruct and compare.
+    w = np.zeros((8, 8))
+    for s, c in shifts:
+        for i in range(8):
+            w[i, (i + s) % 8] = c[i]
+    np.testing.assert_allclose(w, mm.matrices[0])
+
+
+def test_shift_decomposition_dense_bails():
+    mm = build_mixing_matrices("complete", "stochastic", 8, seed=0)
+    assert shift_decomposition(mm.matrices[0], max_shifts=3) is None
+
+
+def test_spectral_gap_ordering():
+    ring = build_mixing_matrices("circle", "metropolis", 16)
+    complete = build_mixing_matrices("complete", "metropolis", 16)
+    assert complete.spectral_gap() > ring.spectral_gap()
+
+
+def test_stacked_shape():
+    mm = build_mixing_matrices("dynamic", "stochastic", 6, seed=0)
+    assert mm.stacked().shape == (6, 6, 6)
+    assert isinstance(mm, MixingMatrices)
