@@ -1,0 +1,15 @@
+from dopt.data.datasets import Dataset, load_dataset
+from dopt.data.partition import iid_split, noniid_split, partition
+from dopt.data.pipeline import BatchPlan, eval_batches, make_batch_plan, gather_batches
+
+__all__ = [
+    "Dataset",
+    "load_dataset",
+    "iid_split",
+    "noniid_split",
+    "partition",
+    "BatchPlan",
+    "eval_batches",
+    "make_batch_plan",
+    "gather_batches",
+]

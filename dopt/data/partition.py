@@ -1,0 +1,90 @@
+"""IID / non-IID data partitioning across workers.
+
+Generalises the reference's two partitioner families into one pair:
+
+* ``iid_split`` — random equal split without replacement
+  (``Distributed Optimization/src/sampling.py:3-9``; P1's
+  ``mnist_iid``/``cifar_iid``, ``Decentralized Optimization/src/sampling.py:5-12,42-49``).
+* ``noniid_split`` — sort-by-label sharding, ``shards`` shards per user
+  (``Distributed Optimization/src/sampling.py:11-28``; subsumes P1's
+  hardcoded per-``num_users`` shard tables, sampling.py:15-39).
+
+Outputs are both the reference-shaped ``{user: index array}`` dict and a
+dense ``[num_users, shard_len]`` int32 matrix (equal-length via
+truncation-to-min or pad-by-wraparound) — the form the TPU pipeline
+consumes (SURVEY §3.3 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def iid_split(labels: np.ndarray, num_users: int, *, seed: int = 0) -> dict[int, np.ndarray]:
+    """Random equal split; every sample used at most once."""
+    n = len(labels)
+    per_user = n // num_users
+    if per_user < 1:
+        raise ValueError(f"cannot split {n} samples across {num_users} users")
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    return {
+        i: np.sort(perm[i * per_user:(i + 1) * per_user]).astype(np.int64)
+        for i in range(num_users)
+    }
+
+
+def noniid_split(
+    labels: np.ndarray,
+    num_users: int,
+    *,
+    shards_per_user: int = 2,
+    seed: int = 0,
+) -> dict[int, np.ndarray]:
+    """Pathological non-IID: sort by label, carve into
+    ``num_users * shards_per_user`` contiguous shards, deal
+    ``shards_per_user`` random shards to each user — each user then sees
+    ~``shards_per_user`` classes only."""
+    n = len(labels)
+    num_shards = num_users * shards_per_user
+    shard_len = n // num_shards
+    if shard_len < 1:
+        raise ValueError(
+            f"cannot carve {n} samples into {num_shards} shards "
+            f"({num_users} users x {shards_per_user} shards)"
+        )
+    order = np.argsort(labels, kind="stable")
+    rng = np.random.default_rng(seed)
+    shard_ids = rng.permutation(num_shards)
+    out: dict[int, np.ndarray] = {}
+    for i in range(num_users):
+        mine = shard_ids[i * shards_per_user:(i + 1) * shards_per_user]
+        idx = np.concatenate([
+            order[s * shard_len:(s + 1) * shard_len] for s in mine
+        ])
+        out[i] = np.sort(idx).astype(np.int64)
+    return out
+
+
+def partition(
+    labels: np.ndarray,
+    num_users: int,
+    *,
+    iid: bool = True,
+    shards_per_user: int = 2,
+    seed: int = 0,
+) -> tuple[dict[int, np.ndarray], np.ndarray]:
+    """Partition + dense matrix form.
+
+    Returns ``(user_groups, index_matrix)`` where ``index_matrix`` is
+    [num_users, L] with L = min user shard length (sizes are equal for
+    both splitters by construction, so nothing is dropped in practice).
+    """
+    groups = (
+        iid_split(labels, num_users, seed=seed)
+        if iid
+        else noniid_split(labels, num_users, shards_per_user=shards_per_user, seed=seed)
+    )
+    lmin = min(len(v) for v in groups.values())
+    matrix = np.stack([groups[i][:lmin] for i in range(num_users)]).astype(np.int32)
+    return groups, matrix

@@ -1,0 +1,127 @@
+"""Host-side batch planning for the stacked-worker TPU engine.
+
+The reference gives each client its own ``DataLoader(shuffle=True)``
+(``Decentralized Optimization/src/clients.py:16-34``).  The TPU engine
+instead runs ONE program over a ``[workers, ...]`` stacked state, so
+batching becomes data: a deterministic per-(round, epoch, worker)
+shuffled index tensor, gathered host-side into
+``[workers, steps, batch, ...]`` arrays and sharded along the worker
+mesh axis (SURVEY §7 hard part: per-worker data feeding one program).
+
+Static shapes for XLA: the last partial batch is padded by wraparound
+with a 0/1 sample-weight mask; losses and metrics are mask-weighted so
+padding never changes the math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BatchPlan:
+    """Index plan for one round of local training on every worker.
+
+    idx:    [W, S, B] int32 — S = local_ep * steps_per_epoch gather indices
+    weight: [W, S, B] float32 — 1.0 for real samples, 0.0 for padding
+    """
+
+    idx: np.ndarray
+    weight: np.ndarray
+
+    @property
+    def num_workers(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def steps(self) -> int:
+        return self.idx.shape[1]
+
+    @property
+    def batch_size(self) -> int:
+        return self.idx.shape[2]
+
+
+def make_batch_plan(
+    index_matrix: np.ndarray,
+    *,
+    batch_size: int,
+    local_ep: int = 1,
+    seed: int = 0,
+    round_idx: int = 0,
+    drop_last: bool = False,
+) -> BatchPlan:
+    """Build the shuffled batch plan for one round.
+
+    ``index_matrix`` is [W, L] per-worker dataset indices (from
+    ``dopt.data.partition``).  Shuffling is deterministic in
+    (seed, round_idx, epoch, worker) so the torch oracle and the jax
+    engine consume byte-identical batches — that determinism is what
+    makes step-level numerics parity testable at all.
+    """
+    w, l = index_matrix.shape
+    bs = min(batch_size, l)
+    if drop_last:
+        steps_per_epoch = l // bs
+        padded = steps_per_epoch * bs
+    else:
+        steps_per_epoch = -(-l // bs)  # ceil
+        padded = steps_per_epoch * bs
+    s = local_ep * steps_per_epoch
+
+    idx = np.empty((w, s, bs), dtype=np.int32)
+    weight = np.empty((w, s, bs), dtype=np.float32)
+    for wi in range(w):
+        rows_i = []
+        mask_i = []
+        for ep in range(local_ep):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, round_idx, ep, wi])
+            )
+            perm = rng.permutation(l)
+            if drop_last:
+                perm = perm[:padded]
+                mask = np.ones(padded, np.float32)
+            else:
+                pad = padded - l
+                mask = np.concatenate([np.ones(l, np.float32), np.zeros(pad, np.float32)])
+                perm = np.concatenate([perm, perm[:pad]]) if pad else perm
+            rows_i.append(index_matrix[wi][perm].reshape(steps_per_epoch, bs))
+            mask_i.append(mask.reshape(steps_per_epoch, bs))
+        idx[wi] = np.concatenate(rows_i, axis=0)
+        weight[wi] = np.concatenate(mask_i, axis=0)
+    return BatchPlan(idx=idx, weight=weight)
+
+
+def gather_batches(
+    x: np.ndarray, y: np.ndarray, plan: BatchPlan
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Materialise [W, S, B, ...] feature / label / weight arrays from a
+    plan — the host→device transfer payload for one round."""
+    bx = x[plan.idx]            # [W, S, B, ...]
+    by = y[plan.idx].astype(np.int32)
+    return bx, by, plan.weight
+
+
+def eval_batches(
+    x: np.ndarray, y: np.ndarray, *, batch_size: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Static-shape eval split: [S, B, ...] with wraparound padding mask
+    (shared by all workers — evaluation uses the full test set, matching
+    the reference's per-client test loader over the whole test split)."""
+    n = len(y)
+    bs = min(batch_size, n)
+    steps = -(-n // bs)
+    padded = steps * bs
+    pad = padded - n
+    idx = np.arange(n)
+    if pad:
+        idx = np.concatenate([idx, idx[:pad]])
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    return (
+        x[idx].reshape(steps, bs, *x.shape[1:]),
+        y[idx].reshape(steps, bs).astype(np.int32),
+        mask.reshape(steps, bs),
+    )

@@ -205,7 +205,7 @@ def _uniform_weights(graphs: Sequence[np.ndarray], self_weight: bool) -> list[np
     for g in graphs:
         a = g + np.eye(g.shape[0]) if self_weight else g.copy()
         rs = a.sum(axis=1, keepdims=True)
-        out.append(a / np.where(rs == 0, 1.0, rs))
+        out.append(_with_isolated_self_loops(a / np.where(rs == 0, 1.0, rs)))
     return out
 
 

@@ -1,0 +1,121 @@
+"""Data layer: parsers, synthetic fallback, partitioners, batch plans."""
+
+import numpy as np
+import pytest
+
+from dopt.data import (
+    BatchPlan,
+    gather_batches,
+    iid_split,
+    load_dataset,
+    make_batch_plan,
+    noniid_split,
+    partition,
+)
+from dopt.data.datasets import make_synthetic
+from dopt.data.pipeline import eval_batches
+
+
+def test_synthetic_deterministic_and_learnable():
+    a = make_synthetic(seed=3, train_size=256, test_size=64)
+    b = make_synthetic(seed=3, train_size=256, test_size=64)
+    np.testing.assert_array_equal(a.train_x, b.train_x)
+    assert a.train_x.shape == (256, 28, 28, 1)
+    assert a.num_classes == 10
+    # Nearest-prototype classification must beat chance by a wide margin
+    # (the data is learnable by construction).
+    protos = np.stack([a.train_x[a.train_y == c].mean(0).ravel() for c in range(10)])
+    d = ((a.test_x.reshape(len(a.test_y), -1)[:, None, :] - protos[None]) ** 2).sum(-1)
+    acc = (d.argmin(1) == a.test_y).mean()
+    assert acc > 0.8
+
+
+def test_load_dataset_synthetic_fallback():
+    ds = load_dataset("mnist", data_dir=None, train_size=128, test_size=32)
+    assert ds.name == "synthetic[mnist]"
+    assert ds.input_shape == (28, 28, 1)
+    ds = load_dataset("cifar10", train_size=64, test_size=16)
+    assert ds.input_shape == (32, 32, 3)
+    ds = load_dataset("a9a", train_size=64, test_size=16)
+    assert ds.input_shape == (123,) and ds.num_classes == 2
+
+
+def test_load_dataset_no_fallback_raises():
+    with pytest.raises(FileNotFoundError):
+        load_dataset("mnist", synthetic_fallback=False)
+
+
+def test_iid_split_disjoint_equal():
+    labels = np.arange(1000) % 10
+    groups = iid_split(labels, 8, seed=0)
+    all_idx = np.concatenate(list(groups.values()))
+    assert len(all_idx) == len(set(all_idx)), "no sample assigned twice"
+    assert all(len(v) == 125 for v in groups.values())
+
+
+def test_noniid_split_label_concentration():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, size=2000)
+    groups = noniid_split(labels, 10, shards_per_user=2, seed=1)
+    all_idx = np.concatenate(list(groups.values()))
+    assert len(all_idx) == len(set(all_idx))
+    # With 2 shards/user each user sees at most 4 distinct labels (each
+    # contiguous label-sorted shard can straddle one label boundary).
+    for v in groups.values():
+        assert len(np.unique(labels[v])) <= 4
+
+
+def test_partition_matrix_shape():
+    labels = np.arange(1024) % 10
+    groups, mat = partition(labels, 8, iid=True, seed=0)
+    assert mat.shape == (8, 128)
+    assert mat.dtype == np.int32
+
+
+def test_batch_plan_shapes_and_mask():
+    mat = np.arange(8 * 100, dtype=np.int64).reshape(8, 100)
+    plan = make_batch_plan(mat, batch_size=32, local_ep=2, seed=0, round_idx=0)
+    # ceil(100/32)=4 steps/epoch, 2 epochs
+    assert plan.idx.shape == (8, 8, 32)
+    assert plan.weight.shape == (8, 8, 32)
+    # each epoch covers every sample exactly once among mask-1 entries
+    for wi in range(8):
+        ep0 = plan.idx[wi, :4][plan.weight[wi, :4] == 1.0]
+        assert sorted(ep0.tolist()) == mat[wi].tolist()
+    # padding count = 4*32-100 = 28 per epoch
+    assert (plan.weight[0] == 0).sum() == 2 * 28
+
+
+def test_batch_plan_deterministic_and_round_varying():
+    mat = np.arange(4 * 64).reshape(4, 64)
+    a = make_batch_plan(mat, batch_size=16, local_ep=1, seed=5, round_idx=3)
+    b = make_batch_plan(mat, batch_size=16, local_ep=1, seed=5, round_idx=3)
+    c = make_batch_plan(mat, batch_size=16, local_ep=1, seed=5, round_idx=4)
+    np.testing.assert_array_equal(a.idx, b.idx)
+    assert not np.array_equal(a.idx, c.idx)
+
+
+def test_batch_plan_drop_last():
+    mat = np.arange(2 * 100).reshape(2, 100)
+    plan = make_batch_plan(mat, batch_size=32, local_ep=1, drop_last=True)
+    assert plan.idx.shape == (2, 3, 32)
+    assert np.all(plan.weight == 1.0)
+
+
+def test_gather_batches():
+    ds = make_synthetic(seed=0, train_size=200, test_size=50)
+    _, mat = partition(ds.train_y, 4, iid=True, seed=0)
+    plan = make_batch_plan(mat, batch_size=10, local_ep=1, seed=0)
+    bx, by, bw = gather_batches(ds.train_x, ds.train_y, plan)
+    assert bx.shape == (4, 5, 10, 28, 28, 1)
+    assert by.shape == (4, 5, 10)
+    assert isinstance(plan, BatchPlan)
+    # labels round-trip through the gather
+    np.testing.assert_array_equal(by[0, 0], ds.train_y[plan.idx[0, 0]])
+
+
+def test_eval_batches_mask():
+    ds = make_synthetic(seed=0, train_size=64, test_size=50)
+    ex, ey, ew = eval_batches(ds.test_x, ds.test_y, batch_size=32)
+    assert ex.shape == (2, 32, 28, 28, 1)
+    assert ew.sum() == 50
