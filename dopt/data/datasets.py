@@ -68,15 +68,29 @@ def _read_idx(path: Path) -> np.ndarray:
     return data.reshape(dims)
 
 
+_LISTING_CACHE: dict[Path, dict[str, Path]] = {}
+
+
+def _listing(data_dir: Path) -> dict[str, Path]:
+    """One recursive walk per data_dir, cached: filename -> first path."""
+    if data_dir not in _LISTING_CACHE:
+        table: dict[str, Path] = {}
+        for p in sorted(data_dir.rglob("*")):
+            if p.is_file():
+                table.setdefault(p.name, p)
+        _LISTING_CACHE[data_dir] = table
+    return _LISTING_CACHE[data_dir]
+
+
 def _find(data_dir: Path, names: list[str]) -> Path | None:
     for name in names:
         for cand in (data_dir / name, data_dir / (name + ".gz")):
             if cand.is_file():
                 return cand
-        hits = [p for p in (*data_dir.rglob(name), *data_dir.rglob(name + ".gz"))
-                if p.is_file()]
-        if hits:
-            return hits[0]
+        table = _listing(data_dir)
+        hit = table.get(name) or table.get(name + ".gz")
+        if hit is not None:
+            return hit
     return None
 
 
@@ -169,7 +183,11 @@ def _load_a9a(data_dir: Path) -> Dataset | None:
     if test_p is not None:
         test_x, test_y = parse(test_p)
     else:
+        # Shuffle before the 80/20 cut: LIBSVM dumps are often
+        # label-sorted, and an ordered cut would skew the test split.
         n = len(train_x)
+        perm = np.random.default_rng(0).permutation(n)
+        train_x, train_y = train_x[perm], train_y[perm]
         cut = int(0.8 * n)
         train_x, test_x = train_x[:cut], train_x[cut:]
         train_y, test_y = train_y[:cut], train_y[cut:]

@@ -1,0 +1,22 @@
+from dopt.models.zoo import (
+    MLP,
+    LogisticRegression,
+    Model1,
+    Model3,
+    ResNet18,
+    build_model,
+    count_params,
+)
+from dopt.models.losses import cross_entropy, accuracy
+
+__all__ = [
+    "MLP",
+    "LogisticRegression",
+    "Model1",
+    "Model3",
+    "ResNet18",
+    "build_model",
+    "count_params",
+    "cross_entropy",
+    "accuracy",
+]

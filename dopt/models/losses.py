@@ -1,0 +1,54 @@
+"""Loss and metric functions shared by both backends.
+
+``cross_entropy`` reproduces the reference objective exactly:
+``CrossEntropyLoss`` applied to the model output.  With the faithful
+head the model output is already softmax probabilities, so the loss is
+``-log_softmax(probs)[y]`` — the double softmax the reference's
+published accuracies were produced with (SURVEY §3.4).  With the
+corrected head the output is logits and this is the standard softmax CE.
+
+The per-sample weights come from the batch-plan padding masks
+(``dopt.data.pipeline``); a weighted mean with ``Σw`` in the denominator
+makes padded samples mathematically invisible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(outputs: jnp.ndarray, labels: jnp.ndarray,
+                  weights: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Mean CE over the batch, exactly ``nn.CrossEntropyLoss(outputs, y)``.
+
+    ``outputs`` is whatever the model head emits (probabilities in
+    faithful mode, logits otherwise) — CrossEntropyLoss semantics apply
+    log_softmax to its input regardless, which is what makes the
+    faithful path a double softmax.
+    """
+    logp = jax.nn.log_softmax(outputs.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if weights is None:
+        return jnp.mean(nll)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def accuracy(outputs: jnp.ndarray, labels: jnp.ndarray,
+             weights: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Fraction of correct argmax predictions (softmax is monotone, so
+    faithful vs corrected head give identical argmax)."""
+    pred = jnp.argmax(outputs, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    if weights is None:
+        return jnp.mean(correct)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(correct * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def l2_regulariser(params, lam: float) -> jnp.ndarray:
+    """ℓ2 penalty for the a9a logistic-regression ADMM config."""
+    sq = sum(jnp.sum(p.astype(jnp.float32) ** 2)
+             for p in jax.tree_util.tree_leaves(params))
+    return 0.5 * lam * sq

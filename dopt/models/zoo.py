@@ -1,0 +1,201 @@
+"""Model zoo in flax.linen (TPU compute path).
+
+Re-creates the reference's two CNNs with exact parameter-count parity
+(``models.py`` in both reference projects — `Model1`: 1,663,370 params
+for MNIST/FMNIST, `Model3`: 1,105,098 for CIFAR-10) and adds the models
+the benchmark configs need: an MLP, ℓ2-regularised logistic regression
+(a9a / ADMM), and a GroupNorm ResNet-18 for the 32-worker CIFAR-10
+north-star config.
+
+Faithful-head semantics: the reference ends both CNNs in ``nn.Softmax``
+*and* trains with ``CrossEntropyLoss`` (which applies log_softmax
+internally) — a double softmax (SURVEY §3.4).  ``faithful_head=True``
+reproduces that: ``__call__`` returns *probabilities* and the loss in
+``dopt.models.losses`` applies log_softmax on top, bit-matching the
+reference's objective.  ``faithful_head=False`` returns logits (the
+corrected, idiomatic head).
+
+Data layout is NHWC (TPU-native).  The reference flattens NCHW
+channel-major before its first Dense layer; parameter-conversion
+helpers in ``dopt.engine.oracle`` handle that reordering so torch and
+flax foward passes are comparable element-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _head(x: jnp.ndarray, faithful: bool) -> jnp.ndarray:
+    """Output head: softmax probabilities in faithful mode (the
+    reference's double-softmax objective), logits otherwise."""
+    if faithful:
+        return jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+    return x
+
+
+class _ReferenceCNN(nn.Module):
+    """Shared body of the reference's two CNNs (``models.py`` both
+    projects): conv(·→32,k5,SAME) → maxpool2 → conv(32→64,k5,SAME) →
+    maxpool2 → Dense(hidden) → ReLU → Dense(num_classes) [→ Softmax].
+    They differ only in the first Dense width."""
+
+    hidden: int = 512
+    num_classes: int = 10
+    faithful_head: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return _head(x, self.faithful_head)
+
+
+class Model1(_ReferenceCNN):
+    """MNIST/FMNIST CNN (reference ``models.py:6-27``), 1,663,370 params."""
+
+    hidden: int = 512
+
+
+class Model3(_ReferenceCNN):
+    """CIFAR CNN (reference ``models.py:31-51``), 1,105,098 params @ 10 classes."""
+
+    hidden: int = 256
+
+
+class MLP(nn.Module):
+    """Small MLP (BASELINE.json config 1: 4-worker MNIST MLP)."""
+
+    hidden: Sequence[int] = (200, 200)
+    num_classes: int = 10
+    faithful_head: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"fc{i+1}")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return _head(x, self.faithful_head)
+
+
+class LogisticRegression(nn.Module):
+    """ℓ2-regularised logistic regression (BASELINE.json config 4:
+    16-worker ADMM on a9a).  The ℓ2 term lives in the loss, not here."""
+
+    num_classes: int = 2
+    faithful_head: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)
+        return _head(x, self.faithful_head)
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.features))(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    """CIFAR-style ResNet-18 with GroupNorm (BASELINE.json config 5:
+    32-worker gossip SGD, CIFAR-10, time-varying random graphs).
+
+    GroupNorm instead of BatchNorm: batch statistics are ill-defined
+    under federated/gossip averaging (each worker's running stats
+    diverge and averaging them is not principled), and GN keeps the
+    model a pure function of (params, batch) — no mutable state to
+    thread through the stacked-worker engine.  Standard choice in the
+    FL literature.
+    """
+
+    num_classes: int = 10
+    faithful_head: bool = False
+    dtype: Any = jnp.float32
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=32)(x)
+        x = nn.relu(x)
+        for stage, blocks in enumerate(self.stage_sizes):
+            features = 64 * (2 ** stage)
+            for b in range(blocks):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = ResidualBlock(features, strides=strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return _head(x, self.faithful_head)
+
+
+_ZOO = {
+    "model1": Model1,
+    "model3": Model3,
+    "mlp": MLP,
+    "logistic": LogisticRegression,
+    "resnet18": ResNet18,
+}
+
+
+def build_model(
+    name: str,
+    *,
+    num_classes: int = 10,
+    faithful_head: bool | None = None,
+    dtype: Any = jnp.float32,
+) -> nn.Module:
+    """Model dispatch by name — the typed replacement for the reference's
+    if/elif on ``args.model`` (``servers.py:33-40``, ``simulators.py:31-38``).
+
+    ``faithful_head=None`` keeps each model's own default: True only for
+    the two reference CNNs (which have a double-softmax to be faithful
+    to), False for mlp/logistic/resnet18 (new models, corrected head).
+    """
+    key = name.lower()
+    if key not in _ZOO:
+        raise ValueError(f"unknown model {name!r}; one of {sorted(_ZOO)}")
+    kwargs: dict[str, Any] = dict(num_classes=num_classes, dtype=dtype)
+    if faithful_head is not None:
+        kwargs["faithful_head"] = faithful_head
+    return _ZOO[key](**kwargs)
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

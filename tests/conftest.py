@@ -23,6 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _no_real_data_dir(monkeypatch):
+    """Synthetic-fallback tests must not pick up a machine-local dataset
+    directory via $DOPT_DATA_DIR."""
+    monkeypatch.delenv("DOPT_DATA_DIR", raising=False)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

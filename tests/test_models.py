@@ -1,0 +1,91 @@
+"""Model zoo: param-count parity with the reference, head semantics, loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dopt.models import build_model, count_params
+from dopt.models.losses import accuracy, cross_entropy, l2_regulariser
+
+
+def _init(model, shape):
+    return model.init(jax.random.key(0), jnp.zeros((1, *shape)))["params"]
+
+
+def test_model1_param_count_parity():
+    # Reference models.py:5 comment — 1,663,370 params, arithmetic verified.
+    params = _init(build_model("model1"), (28, 28, 1))
+    assert count_params(params) == 1_663_370
+
+
+def test_model3_param_count_parity():
+    # Reference models.py:30 comment — 1,105,098 params.
+    params = _init(build_model("model3", num_classes=10), (32, 32, 3))
+    assert count_params(params) == 1_105_098
+
+
+def test_faithful_head_returns_probabilities():
+    m = build_model("model1", faithful_head=True)
+    params = _init(m, (28, 28, 1))
+    out = m.apply({"params": params}, jnp.ones((4, 28, 28, 1)))
+    np.testing.assert_allclose(np.sum(out, axis=-1), 1.0, rtol=1e-5)
+    assert np.all(out >= 0)
+
+
+def test_corrected_head_returns_logits():
+    m = build_model("model1", faithful_head=False)
+    params = _init(m, (28, 28, 1))
+    out = m.apply({"params": params}, jnp.ones((4, 28, 28, 1)))
+    assert not np.allclose(np.sum(out, axis=-1), 1.0)
+
+
+def test_double_softmax_loss_differs_from_corrected():
+    # The faithful objective is NOT the standard CE — make sure we are
+    # really reproducing the reference's bug.
+    logits = jnp.array([[2.0, -1.0, 0.5]])
+    labels = jnp.array([0])
+    corrected = cross_entropy(logits, labels)
+    faithful = cross_entropy(jax.nn.softmax(logits), labels)
+    assert abs(float(corrected) - float(faithful)) > 0.1
+
+
+def test_cross_entropy_weighted_mask():
+    out = jnp.array([[5.0, 0.0], [0.0, 5.0], [9.9, 9.9]])
+    y = jnp.array([0, 1, 0])
+    w = jnp.array([1.0, 1.0, 0.0])
+    full = cross_entropy(out[:2], y[:2])
+    masked = cross_entropy(out, y, w)
+    np.testing.assert_allclose(float(full), float(masked), rtol=1e-6)
+
+
+def test_accuracy_mask():
+    out = jnp.array([[1.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    y = jnp.array([0, 1, 1])
+    assert float(accuracy(out, y)) == pytest.approx(2 / 3)
+    assert float(accuracy(out, y, jnp.array([1.0, 1.0, 0.0]))) == pytest.approx(0.5)
+
+
+def test_mlp_and_logistic():
+    m = build_model("mlp", faithful_head=False)
+    p = _init(m, (28, 28, 1))
+    assert m.apply({"params": p}, jnp.ones((2, 28, 28, 1))).shape == (2, 10)
+    lr = build_model("logistic", num_classes=2, faithful_head=False)
+    plr = _init(lr, (123,))
+    assert lr.apply({"params": plr}, jnp.ones((2, 123))).shape == (2, 2)
+    assert count_params(plr) == 123 * 2 + 2
+    assert float(l2_regulariser(plr, 0.0)) == 0.0
+
+
+def test_resnet18_forward():
+    m = build_model("resnet18", faithful_head=False)
+    p = _init(m, (32, 32, 3))
+    n = count_params(p)
+    assert 10_000_000 < n < 12_000_000, n  # ~11.2M standard ResNet-18
+    out = m.apply({"params": p}, jnp.ones((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_build_model_unknown():
+    with pytest.raises(ValueError, match="unknown model"):
+        build_model("model2")

@@ -98,6 +98,14 @@ def test_metropolis_doubly_stochastic_with_self_loops():
     assert mm.spectral_gap() > 0
 
 
+@pytest.mark.parametrize("topology", ["circle", "star", "dynamic"])
+def test_uniform_mode_row_stochastic_even_when_isolated(topology):
+    # Regression: uniform mode must give isolated workers (dynamic
+    # single-edge rounds) an identity row, not an all-zero row.
+    mm = build_mixing_matrices(topology, "uniform", 6)
+    assert mm.is_row_stochastic()
+
+
 def test_ones_mode_is_raw_adjacency():
     mm = build_mixing_matrices("complete", "ones", 4)
     assert np.array_equal(mm.matrices[0], np.ones((4, 4)) - np.eye(4))
