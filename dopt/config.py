@@ -37,8 +37,8 @@ class ModelConfig:
     """Model zoo selection (reference ``args.model`` string dispatch)."""
 
     model: str = "model1"    # model1 | model3 | mlp | resnet18 | logistic
-    faithful_head: bool = True
-    # faithful_head=True reproduces the reference's Softmax-head +
+    faithful: bool = True
+    # faithful=True reproduces the reference's Softmax-head +
     # CrossEntropyLoss double-softmax (models.py:22-27 + clients.py:11);
     # False uses the corrected logits head.
     num_classes: int = 10
@@ -165,7 +165,7 @@ def from_reference_args(args: Mapping[str, Any]) -> ExperimentConfig:
         model=model_name,
         num_classes=num_classes,
         input_shape=input_shape,
-        faithful_head=bool(_get("faithful_head", True)),
+        faithful=bool(_get("faithful", True)),
     )
     optim = OptimizerConfig(
         lr=float(_get("lr", 0.01)),

@@ -68,30 +68,55 @@ def _read_idx(path: Path) -> np.ndarray:
     return data.reshape(dims)
 
 
-_LISTING_CACHE: dict[Path, dict[str, Path]] = {}
+class _Finder:
+    """File discovery under a data root: one recursive walk per
+    ``load_dataset`` call (cached for that call only, so files added
+    between calls are seen), with dataset-name-aware ranking — under a
+    shared root holding both ``MNIST/raw/`` and ``FashionMNIST/raw/``
+    (identical IDX filenames, torchvision layout) the path whose parents
+    mention the requested dataset wins."""
 
+    def __init__(self, data_dir: Path, prefer: tuple[str, ...] = (),
+                 avoid: tuple[str, ...] = ()):
+        self.data_dir = data_dir
+        self.prefer = tuple(t.lower() for t in prefer)
+        self.avoid = tuple(t.lower() for t in avoid)
+        self._table: dict[str, list[Path]] | None = None
 
-def _listing(data_dir: Path) -> dict[str, Path]:
-    """One recursive walk per data_dir, cached: filename -> first path."""
-    if data_dir not in _LISTING_CACHE:
-        table: dict[str, Path] = {}
-        for p in sorted(data_dir.rglob("*")):
-            if p.is_file():
-                table.setdefault(p.name, p)
-        _LISTING_CACHE[data_dir] = table
-    return _LISTING_CACHE[data_dir]
+    def _listing(self) -> dict[str, list[Path]]:
+        if self._table is None:
+            table: dict[str, list[Path]] = {}
+            for p in sorted(self.data_dir.rglob("*")):
+                if p.is_file():
+                    table.setdefault(p.name, []).append(p)
+            self._table = table
+        return self._table
+
+    def _rank(self, p: Path) -> tuple[int, int]:
+        s = str(p).lower()
+        preferred = any(t in s for t in self.prefer)
+        avoided = any(t in s for t in self.avoid)
+        return (0 if preferred else 1, 1 if avoided else 0)
+
+    def find(self, names: list[str]) -> Path | None:
+        for name in names:
+            for cand in (self.data_dir / name, self.data_dir / (name + ".gz")):
+                if cand.is_file():
+                    return cand
+            table = self._listing()
+            hits = table.get(name, []) + table.get(name + ".gz", [])
+            if hits:
+                best = min(hits, key=self._rank)
+                if self.avoid and self._rank(best)[1] and len(hits) == 1:
+                    # only hit sits under an avoided name -> likely the
+                    # wrong dataset's file; treat as missing
+                    continue
+                return best
+        return None
 
 
 def _find(data_dir: Path, names: list[str]) -> Path | None:
-    for name in names:
-        for cand in (data_dir / name, data_dir / (name + ".gz")):
-            if cand.is_file():
-                return cand
-        table = _listing(data_dir)
-        hit = table.get(name) or table.get(name + ".gz")
-        if hit is not None:
-            return hit
-    return None
+    return _Finder(data_dir).find(names)
 
 
 def _load_mnist_like(name: str, data_dir: Path) -> Dataset | None:
@@ -101,7 +126,11 @@ def _load_mnist_like(name: str, data_dir: Path) -> Dataset | None:
         "test_x": ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
         "test_y": ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"],
     }
-    paths = {k: _find(data_dir, v) for k, v in files.items()}
+    if name == "mnist":
+        finder = _Finder(data_dir, prefer=("mnist",), avoid=("fashion", "fmnist"))
+    else:
+        finder = _Finder(data_dir, prefer=("fashion", "fmnist"))
+    paths = {k: finder.find(v) for k, v in files.items()}
     if any(p is None for p in paths.values()):
         return None
     mean, std = _NORM[name]
@@ -129,10 +158,13 @@ def _load_cifar(name: str, data_dir: Path) -> Dataset | None:
         test_names = ["test"]
         label_key = b"fine_labels"
 
+    finder = _Finder(data_dir, prefer=("cifar-100" if name == "cifar100" else "cifar-10",),
+                     avoid=("cifar-100",) if name == "cifar10" else ())
+
     def read(names):
         xs, ys = [], []
         for n in names:
-            p = _find(data_dir, [n])
+            p = finder.find([n])
             if p is None:
                 return None, None
             with open(p, "rb") as f:

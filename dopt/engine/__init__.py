@@ -1,0 +1,4 @@
+from dopt.engine.federated import FederatedTrainer
+from dopt.engine.gossip import GossipTrainer
+
+__all__ = ["FederatedTrainer", "GossipTrainer"]

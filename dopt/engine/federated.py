@@ -1,0 +1,229 @@
+"""Server-coordinated federated training (the reference's project 1).
+
+Re-creates ``Server``/``FedAvg_Server``/``FedProx_Server``/``FedAdmm_Server``
+(``Decentralized Optimization/src/servers.py``) on the stacked-worker
+mesh engine:
+
+* Client sampling (``np.random.choice``, servers.py:57) becomes a 0/1
+  participation mask over the worker axis; sampled workers load the
+  global model theta, train locally, and theta is re-formed as a masked
+  uniform average (``average_weights``, servers.py:42-48 →
+  ``masked_average`` = one reduce over the worker axis).
+* Unsampled workers keep their stale params/momentum — faithful to the
+  reference, where each client's optimizer (and its momentum buffer)
+  lives for the whole experiment and only sampled clients step.
+* FedProx / FedADMM are gradient edits inside the local scan; the ADMM
+  duals are a worker-stacked (sharded) pytree with dual ascent after the
+  local epochs (clients.py:141-144), only for sampled workers.
+* Faithful wart, kept deliberately: ALL workers compute a local update
+  and the mask discards the unsampled results.  With frac=0.1 this
+  wastes lanes but keeps shapes static; a gather-compact path is a
+  planned fast-mode optimisation.
+
+History schema is P1's: round, test_acc, test_loss (global model on the
+test set), train_loss, train_acc (mean over ALL clients of their own
+model on their own train split — ``avg_trainig_calculator``,
+servers.py:85-93).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dopt.config import ExperimentConfig
+from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
+from dopt.engine.local import (
+    make_evaluator,
+    make_stacked_evaluator,
+    make_stacked_local_update,
+)
+from dopt.models import build_model, count_params
+from dopt.optim import admm_dual_ascent
+from dopt.parallel.collectives import broadcast_to_workers, masked_average
+from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
+from dopt.utils.metrics import History
+from dopt.utils.prng import host_rng
+
+
+def _where_mask(mask, a, b):
+    """Per-worker select over stacked pytrees: mask[i] ? a_i : b_i."""
+    def sel(x, y):
+        m = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(bool)
+        return jnp.where(m, x, y)
+    return jax.tree.map(sel, a, b)
+
+
+class FederatedTrainer:
+    """FedAvg / FedProx / FedADMM with partial participation."""
+
+    def __init__(self, cfg: ExperimentConfig, *, eval_train: bool = True):
+        if cfg.federated is None:
+            raise ValueError("cfg.federated must be set for FederatedTrainer")
+        f = cfg.federated
+        if f.algorithm not in ("fedavg", "fedprox", "fedadmm"):
+            raise ValueError(f"unknown federated algorithm {f.algorithm!r}")
+        self.cfg = cfg
+        self.eval_train = eval_train
+        self.round = 0
+        self.history = History(cfg.name)
+
+        w = cfg.data.num_users
+        self.num_workers = w
+        from dopt.engine.gossip import _mesh_devices_for
+        self.mesh = make_mesh(_mesh_devices_for(w, cfg.mesh_devices))
+        self._sharding = worker_sharding(self.mesh)
+
+        self.dataset = load_dataset(
+            cfg.data.dataset, data_dir=cfg.data.data_dir,
+            train_size=cfg.data.synthetic_train_size,
+            test_size=cfg.data.synthetic_test_size, seed=cfg.seed,
+        )
+        _, self.index_matrix = partition(
+            self.dataset.train_y, w, iid=cfg.data.iid,
+            shards_per_user=cfg.data.shards, seed=cfg.seed,
+        )
+        self._train_x = jnp.asarray(self.dataset.train_x)
+        self._train_y = jnp.asarray(self.dataset.train_y)
+        ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
+                                  batch_size=max(f.local_bs, 256))
+        self._eval = (jnp.asarray(ex), jnp.asarray(ey), jnp.asarray(ew))
+        # Static per-worker train-eval stacks (sequential order) for the
+        # avg_trainig_calculator metric.
+        l = self.index_matrix.shape[1]
+        bs = min(max(f.local_bs, 256), l)
+        steps = -(-l // bs)
+        pad = steps * bs - l
+        ti = np.concatenate([self.index_matrix,
+                             self.index_matrix[:, :pad]], axis=1)
+        self._train_eval_idx = ti.reshape(w, steps, bs)
+        tw = np.concatenate([np.ones((w, l), np.float32),
+                             np.zeros((w, pad), np.float32)], axis=1)
+        self._train_eval_w = tw.reshape(w, steps, bs)
+
+        self.model = build_model(
+            cfg.model.model, num_classes=cfg.model.num_classes,
+            faithful=cfg.model.faithful,
+        )
+        key = jax.random.key(cfg.seed)
+        dummy = jnp.zeros((1, *cfg.model.input_shape))
+        theta0 = self.model.init(key, dummy)["params"]
+        self.param_count = count_params(theta0)
+        self.theta = jax.device_get(theta0)  # global model (replicated)
+        stacked = jax.device_get(broadcast_to_workers(theta0, w))
+        self.params = shard_worker_tree(stacked, self.mesh)
+        self.momentum = shard_worker_tree(
+            jax.tree.map(np.zeros_like, stacked), self.mesh)
+        self.duals = (
+            shard_worker_tree(jax.tree.map(np.zeros_like, stacked), self.mesh)
+            if f.algorithm == "fedadmm" else None
+        )
+
+        local = make_stacked_local_update(
+            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            algorithm={"fedavg": "sgd", "fedprox": "fedprox",
+                       "fedadmm": "fedadmm"}[f.algorithm],
+            rho=cfg.optim.rho,
+        )
+        global_eval = make_evaluator(self.model.apply)
+        stacked_eval = make_stacked_evaluator(self.model.apply)
+        algorithm = f.algorithm
+        rho = cfg.optim.rho
+        eval_train_flag = eval_train
+
+        def round_fn(theta, params, mom, duals, mask, idx, bweight,
+                     train_x, train_y, ex, ey, ew, tidx, tweight):
+            bx = train_x[idx]
+            by = train_y[idx]
+            theta_b = broadcast_to_workers(theta, w)
+            start = _where_mask(mask, theta_b, params)
+            if algorithm == "fedavg":
+                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight)
+                new_duals = duals
+            elif algorithm == "fedprox":
+                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight, theta)
+                new_duals = duals
+            else:
+                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight,
+                                               theta, duals)
+                ascended = jax.vmap(
+                    lambda a, p: admm_dual_ascent(a, p, theta, rho),
+                    in_axes=(0, 0),
+                )(duals, p_t)
+                new_duals = _where_mask(mask, ascended, duals)
+            new_p = _where_mask(mask, p_t, params)
+            new_m = _where_mask(mask, m_t, mom)
+            new_theta = masked_average(new_p, mask)
+            evalm = global_eval(new_theta, ex, ey, ew)
+            if eval_train_flag:
+                tx = train_x[tidx]
+                ty = train_y[tidx]
+                trainm = stacked_eval_perworker(new_p, tx, ty, tweight)
+            else:
+                trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
+                          "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
+            local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
+            return new_theta, new_p, new_m, new_duals, local_loss, evalm, trainm
+
+        # Per-worker train-split eval: every input has a worker axis.
+        stacked_eval_perworker = jax.vmap(
+            lambda p, ex_, ey_, ew_: make_evaluator(self.model.apply)(p, ex_, ey_, ew_),
+            in_axes=(0, 0, 0, 0),
+        )
+
+        self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
+        self._global_eval = jax.jit(global_eval)
+        self._sample_rng = host_rng(cfg.seed, 314159)
+
+    # ------------------------------------------------------------------
+    def sample_clients(self, frac: float) -> np.ndarray:
+        """m = max(int(frac*N), 1) clients without replacement
+        (servers.py:52,57) as a 0/1 mask."""
+        m = max(int(frac * self.num_workers), 1)
+        chosen = self._sample_rng.choice(self.num_workers, m, replace=False)
+        mask = np.zeros(self.num_workers, np.float32)
+        mask[chosen] = 1.0
+        return mask
+
+    def run(self, frac: float | None = None, rounds: int | None = None) -> History:
+        cfg, f = self.cfg, self.cfg.federated
+        frac = f.frac if frac is None else frac
+        rounds = f.rounds if rounds is None else rounds
+        t0 = time.time()
+        for _ in range(rounds):
+            t = self.round
+            mask = self.sample_clients(frac)
+            plan = make_batch_plan(
+                self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
+                seed=cfg.seed, round_idx=t,
+            )
+            idx = jax.device_put(plan.idx, self._sharding)
+            bweight = jax.device_put(plan.weight, self._sharding)
+            duals_in = self.duals if self.duals is not None else {}
+            (self.theta, self.params, self.momentum, new_duals,
+             local_loss, evalm, trainm) = self._round_fn(
+                self.theta, self.params, self.momentum, duals_in,
+                jnp.asarray(mask), idx, bweight,
+                self._train_x, self._train_y, *self._eval,
+                jnp.asarray(self._train_eval_idx), jnp.asarray(self._train_eval_w),
+            )
+            if self.duals is not None:
+                self.duals = new_duals
+            self.history.append(
+                round=t,
+                test_acc=float(evalm["acc"]),
+                test_loss=float(evalm["loss_sum"]),   # P1 summed-loss flavour
+                train_loss=float(np.mean(np.asarray(trainm["loss_mean"]))),
+                train_acc=float(np.mean(np.asarray(trainm["acc"]))),
+                local_loss=float(local_loss),
+            )
+            self.round += 1
+        self.total_time = time.time() - t0
+        return self.history
+
+    def evaluate_global(self) -> dict[str, float]:
+        out = self._global_eval(self.theta, *self._eval)
+        return {k: float(v) for k, v in out.items()}

@@ -1,0 +1,231 @@
+"""Serverless gossip/consensus training (the reference's project 2).
+
+Re-creates ``Simulator``/``DecFedAvg``/``NoConsDecFedAvg``/``FedLCon``
+(``Distributed Optimization/src/simulators.py``) — and implements
+``GossipLearning``, which the reference declares but leaves an empty
+stub (simulators.py:215-217) — as ONE stacked-worker engine:
+
+* N workers = one [W, ...] pytree sharded over the mesh worker axis.
+* Consensus  x_i ← Σ_j W_ij x_j  = a collective (``mix_dense`` /
+  ``mix_shifts_shardmap``) instead of ``Neighbors()`` passing
+  state_dicts (simulators.py:91-97).
+* Faithful round order (SURVEY §3.2): consensus → eval → local update,
+  with two-phase synchronous semantics for free (pure functions read
+  round-t weights only).
+* The dataset lives on device once; each round ships only the [W, S, B]
+  int32 batch plan and gathers on-device — no per-round host copies of
+  the data.
+
+Round accounting follows the reference: ``self.round`` persists across
+``run()`` calls (servers.py:18,78) and time-varying schedules select
+``matrices[round % len]`` (simulators.py:141-142).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dopt.config import ExperimentConfig
+from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
+from dopt.engine.local import make_stacked_evaluator, make_stacked_local_update
+from dopt.models import build_model, count_params
+from dopt.parallel.collectives import broadcast_to_workers, mix_dense
+from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
+from dopt.topology import MixingMatrices, build_mixing_matrices
+from dopt.utils.metrics import History
+from dopt.utils.prng import host_rng
+
+
+def _mesh_devices_for(num_workers: int, requested: int | None) -> int:
+    avail = len(jax.devices()) if requested is None else requested
+    d = min(num_workers, avail)
+    while num_workers % d:
+        d -= 1
+    return d
+
+
+def random_matching_matrix(n: int, rng: np.random.Generator) -> np.ndarray:
+    """GossipLearning round matrix: a random perfect matching; matched
+    pairs average (w=1/2 each), unmatched (odd n) keep their weights.
+    This is classic pairwise gossip — the algorithm the reference's
+    empty ``GossipLearning`` stub names."""
+    w = np.zeros((n, n))
+    perm = rng.permutation(n)
+    for k in range(0, n - 1, 2):
+        i, j = perm[k], perm[k + 1]
+        w[i, i] = w[j, j] = 0.5
+        w[i, j] = w[j, i] = 0.5
+    if n % 2:
+        i = perm[-1]
+        w[i, i] = 1.0
+    return w
+
+
+class GossipTrainer:
+    """D-SGD / no-consensus / FedLCon / GossipLearning on the mesh.
+
+    algorithm (cfg.gossip.algorithm):
+      'dsgd'        — consensus then local update (DecFedAvg, simulators.py:133-167)
+      'nocons'      — local update only (NoConsDecFedAvg, :110-131)
+      'centralized' — preset: force num_users=1, local_ep=1, iid (:169-174,
+                      without mutating the caller's config object)
+      'fedlcon'     — eps consensus sweeps per round (:176-212, bug fixed;
+                      cfg.gossip.faithful_bugs=True reproduces the
+                      effectively-one-sweep behaviour)
+      'gossip'      — random pairwise matching per round (the stub, implemented)
+    """
+
+    def __init__(self, cfg: ExperimentConfig, *, eval_every: int = 1):
+        if cfg.gossip is None:
+            raise ValueError("cfg.gossip must be set for GossipTrainer")
+        g = cfg.gossip
+        if g.algorithm not in ("dsgd", "nocons", "centralized", "fedlcon", "gossip"):
+            raise ValueError(
+                f"unknown gossip algorithm {g.algorithm!r}; one of "
+                "dsgd|nocons|centralized|fedlcon|gossip"
+            )
+        if g.algorithm == "centralized":
+            # The reference's Centeralized mutates the SHARED args object
+            # (simulators.py:171-173) — we derive a new frozen config.
+            cfg = cfg.replace(
+                data=dataclasses.replace(cfg.data, num_users=1, iid=True),
+                gossip=dataclasses.replace(g, local_ep=1, algorithm="nocons"),
+            )
+            g = cfg.gossip
+        self.cfg = cfg
+        self.eval_every = eval_every
+        self.round = 0
+        self.history = History(cfg.name)
+
+        w = cfg.data.num_users
+        self.num_workers = w
+        self.mesh = make_mesh(_mesh_devices_for(w, cfg.mesh_devices))
+
+        # Data: load, partition, upload once.
+        self.dataset = load_dataset(
+            cfg.data.dataset, data_dir=cfg.data.data_dir,
+            train_size=cfg.data.synthetic_train_size,
+            test_size=cfg.data.synthetic_test_size, seed=cfg.seed,
+        )
+        _, self.index_matrix = partition(
+            self.dataset.train_y, w, iid=cfg.data.iid,
+            shards_per_user=cfg.data.shards, seed=cfg.seed,
+        )
+        self._train_x = jnp.asarray(self.dataset.train_x)
+        self._train_y = jnp.asarray(self.dataset.train_y)
+        ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
+                                  batch_size=max(g.local_bs, 256))
+        self._eval = (jnp.asarray(ex), jnp.asarray(ey), jnp.asarray(ew))
+
+        # Model + stacked state (every worker starts from the same init —
+        # the reference deepcopies one global model, simulators.py:23-24).
+        self.model = build_model(
+            cfg.model.model, num_classes=cfg.model.num_classes,
+            faithful=cfg.model.faithful,
+        )
+        key = jax.random.key(cfg.seed)
+        dummy = jnp.zeros((1, *cfg.model.input_shape))
+        params0 = self.model.init(key, dummy)["params"]
+        self.param_count = count_params(params0)
+        stacked = broadcast_to_workers(params0, w)
+        self.params = shard_worker_tree(jax.device_get(stacked), self.mesh)
+        self.momentum = shard_worker_tree(
+            jax.tree.map(np.zeros_like, jax.device_get(stacked)), self.mesh
+        )
+
+        # Mixing schedule (matrices are data).
+        if g.algorithm in ("dsgd", "fedlcon"):
+            self.mixing: MixingMatrices | None = build_mixing_matrices(
+                g.topology, g.mode, w, seed=cfg.seed, self_weight=g.self_weight,
+            )
+        else:
+            self.mixing = None
+
+        self._matching_rng = host_rng(cfg.seed, 60551)
+
+        # Compiled round step.
+        local = make_stacked_local_update(
+            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            algorithm="sgd",
+        )
+        evaluator = make_stacked_evaluator(self.model.apply)
+        eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
+        do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
+        mesh = self.mesh
+
+        def round_fn(params, mom, w_matrix, idx, bweight, train_x, train_y,
+                     ex, ey, ew, do_eval):
+            if do_mix:
+                for _ in range(eps):
+                    params = mix_dense(params, w_matrix, mesh)
+            evalm = jax.lax.cond(
+                do_eval,
+                lambda: evaluator(params, ex, ey, ew),
+                lambda: {
+                    "acc": jnp.zeros(self.num_workers),
+                    "loss_sum": jnp.zeros(self.num_workers),
+                    "loss_mean": jnp.zeros(self.num_workers),
+                    "count": jnp.zeros(self.num_workers),
+                },
+            )
+            bx = train_x[idx]
+            by = train_y[idx]
+            params, mom, losses, accs = local(params, mom, bx, by, bweight)
+            return params, mom, losses.mean(), accs.mean(), evalm
+
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
+        self._sharding = worker_sharding(self.mesh)
+
+    # ------------------------------------------------------------------
+    def _matrix_for_round(self, t: int) -> np.ndarray:
+        g = self.cfg.gossip
+        if g.algorithm == "gossip":
+            return random_matching_matrix(self.num_workers, self._matching_rng)
+        if self.mixing is not None:
+            return self.mixing.for_round(t)
+        return np.eye(self.num_workers)
+
+    def run(self, rounds: int | None = None, eps: int | None = None) -> History:
+        """Train; mirrors ``Simulator.run(rounds)`` / ``FedLCon.run(rounds, eps)``."""
+        cfg, g = self.cfg, self.cfg.gossip
+        rounds = g.rounds if rounds is None else rounds
+        if eps is not None and eps != g.eps and g.algorithm == "fedlcon":
+            raise ValueError("set eps in GossipConfig (static for compilation)")
+        t0 = time.time()
+        for _ in range(rounds):
+            t = self.round
+            w_t = self._matrix_for_round(t)
+            plan = make_batch_plan(
+                self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
+                seed=cfg.seed, round_idx=t,
+            )
+            idx = jax.device_put(plan.idx, self._sharding)
+            bweight = jax.device_put(plan.weight, self._sharding)
+            do_eval = (t % self.eval_every) == 0
+            self.params, self.momentum, train_loss, train_acc, evalm = self._round_fn(
+                self.params, self.momentum, w_t, idx, bweight,
+                self._train_x, self._train_y, *self._eval, do_eval,
+            )
+            row = {
+                "round": t,
+                "avg_train_loss": float(train_loss),
+                "avg_train_acc": float(train_acc),
+            }
+            if do_eval:
+                row["avg_test_acc"] = float(np.mean(np.asarray(evalm["acc"])))
+                row["avg_test_loss"] = float(np.mean(np.asarray(evalm["loss_mean"])))
+            self.history.append(**row)
+            self.round += 1
+        self.total_time = time.time() - t0
+        return self.history
+
+    # Convenience: per-worker eval of the current state.
+    def evaluate(self) -> dict[str, np.ndarray]:
+        evaluator = make_stacked_evaluator(self.model.apply)
+        out = jax.jit(evaluator)(self.params, *self._eval)
+        return {k: np.asarray(v) for k, v in out.items()}

@@ -1,0 +1,130 @@
+"""The local-training step: per-worker SGD epochs as a ``lax.scan``.
+
+This is the reference's inner hot loop (``Client.update_weights``,
+``Decentralized Optimization/src/clients.py:36-53`` /
+``Client.local_update``, ``Distributed Optimization/src/clients.py:34-59``)
+turned into a pure function: given a worker's params + momentum and a
+[S, B, ...] batch stack (S = local_ep × steps_per_epoch from the batch
+plan), scan SGD steps and return the new state plus per-step metrics.
+
+``make_local_update`` builds the per-worker function; ``vmap`` over the
+leading worker axis turns it into the stacked-engine step.  FedProx and
+FedADMM enter as gradient edits (``dopt.optim``), with the global model
+``theta`` broadcast (in_axes=None) and the ADMM duals stacked per
+worker — the dual variables are worker-sharded pytrees, exactly the
+TPU mapping SURVEY §2.3 calls for.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dopt.models.losses import accuracy, cross_entropy, l2_regulariser
+from dopt.optim import SGDState, admm_grad_edit, prox_grad_edit, sgd_step
+
+
+def make_local_update(
+    apply_fn: Callable,
+    *,
+    lr: float,
+    momentum: float,
+    algorithm: str = "sgd",
+    rho: float = 0.0,
+    l2: float = 0.0,
+):
+    """Build the per-worker local-update function.
+
+    algorithm: 'sgd' (FedAvg / D-SGD local step), 'fedprox', 'fedadmm'.
+    Returns fn(params, mom, bx, by, bw, theta=None, alpha=None) ->
+    (new_params, new_mom, losses[S], accs[S]).
+    """
+    if algorithm not in ("sgd", "fedprox", "fedadmm"):
+        raise ValueError(f"unknown local algorithm {algorithm!r}")
+
+    def local_update(params, mom, bx, by, bw, theta=None, alpha=None):
+        def step(carry, batch):
+            p, m = carry
+            x, y, w = batch
+
+            def loss_fn(p_):
+                out = apply_fn({"params": p_}, x)
+                loss = cross_entropy(out, y, w)
+                if l2:
+                    loss = loss + l2_regulariser(p_, l2)
+                return loss, out
+
+            (loss, out), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            if algorithm == "fedprox":
+                g = prox_grad_edit(g, p, theta, rho)
+            elif algorithm == "fedadmm":
+                g = admm_grad_edit(g, p, theta, alpha, rho)
+            p, st = sgd_step(p, SGDState(m), g, lr=lr, momentum=momentum)
+            return (p, st.momentum), (loss, accuracy(out, y, w))
+
+        (params, mom), (losses, accs) = jax.lax.scan(step, (params, mom), (bx, by, bw))
+        return params, mom, losses, accs
+
+    return local_update
+
+
+def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
+                              rho=0.0, l2=0.0):
+    """vmap the per-worker update over the leading worker axis.
+
+    theta (global model) is broadcast; alpha (ADMM duals) is stacked.
+    """
+    fn = make_local_update(apply_fn, lr=lr, momentum=momentum,
+                           algorithm=algorithm, rho=rho, l2=l2)
+    if algorithm == "sgd":
+        return jax.vmap(lambda p, m, bx, by, bw: fn(p, m, bx, by, bw))
+    if algorithm == "fedprox":
+        return jax.vmap(
+            lambda p, m, bx, by, bw, theta: fn(p, m, bx, by, bw, theta=theta),
+            in_axes=(0, 0, 0, 0, 0, None),
+        )
+    return jax.vmap(
+        lambda p, m, bx, by, bw, theta, alpha: fn(p, m, bx, by, bw,
+                                                  theta=theta, alpha=alpha),
+        in_axes=(0, 0, 0, 0, 0, None, 0),
+    )
+
+
+def make_evaluator(apply_fn):
+    """Batched evaluation over a static [S, B, ...] eval stack.
+
+    Returns fn(params, ex, ey, ew) -> dict with weighted sums so the
+    caller can form either reference metric flavour:
+    P1 ``inference`` returns (acc, summed-per-batch loss)
+    (``Decentralized Optimization/src/clients.py:61-75``), P2 returns
+    (acc, mean-per-batch loss) (``Distributed Optimization/src/clients.py:71-86``).
+    """
+
+    def evaluate(params, ex, ey, ew):
+        def step(carry, batch):
+            x, y, w = batch
+            out = apply_fn({"params": params}, x)
+            loss = cross_entropy(out, y, w)          # weighted mean over batch
+            correct = accuracy(out, y, w) * w.sum()  # weighted correct count
+            return carry, (loss, correct, w.sum())
+
+        _, (losses, corrects, counts) = jax.lax.scan(step, (), (ex, ey, ew))
+        total = jnp.maximum(counts.sum(), 1.0)
+        return {
+            "acc": corrects.sum() / total,
+            "loss_sum": losses.sum(),            # P1 flavour (summed batch losses)
+            "loss_mean": losses.mean(),          # P2 flavour (mean per batch)
+            "count": total,
+        }
+
+    return evaluate
+
+
+def make_stacked_evaluator(apply_fn):
+    """Evaluate every worker's params on the same (replicated) eval stack."""
+    ev = make_evaluator(apply_fn)
+    return jax.vmap(lambda p, ex, ey, ew: ev(p, ex, ey, ew),
+                    in_axes=(0, None, None, None))

@@ -9,10 +9,10 @@ north-star config.
 
 Faithful-head semantics: the reference ends both CNNs in ``nn.Softmax``
 *and* trains with ``CrossEntropyLoss`` (which applies log_softmax
-internally) — a double softmax (SURVEY §3.4).  ``faithful_head=True``
+internally) — a double softmax (SURVEY §3.4).  ``faithful=True``
 reproduces that: ``__call__`` returns *probabilities* and the loss in
 ``dopt.models.losses`` applies log_softmax on top, bit-matching the
-reference's objective.  ``faithful_head=False`` returns logits (the
+reference's objective.  ``faithful=False`` returns logits (the
 corrected, idiomatic head).
 
 Data layout is NHWC (TPU-native).  The reference flattens NCHW
@@ -42,27 +42,36 @@ class _ReferenceCNN(nn.Module):
     """Shared body of the reference's two CNNs (``models.py`` both
     projects): conv(·→32,k5,SAME) → maxpool2 → conv(32→64,k5,SAME) →
     maxpool2 → Dense(hidden) → ReLU → Dense(num_classes) [→ Softmax].
-    They differ only in the first Dense width."""
+    They differ only in the first Dense width.
+
+    Faithful quirk: the reference conv stack has NO activations — the
+    only ReLU sits between the two Dense layers (models.py:10-21).  Two
+    stacked linear convs are a strictly weaker function class, but that
+    is the architecture the published numbers used; ``faithful=True``
+    reproduces it exactly, ``faithful=False`` adds the conventional
+    post-conv ReLUs (and drops the softmax head)."""
 
     hidden: int = 512
     num_classes: int = 10
-    faithful_head: bool = True
+    faithful: bool = True
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         x = x.astype(self.dtype)
         x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
-        x = nn.relu(x)
+        if not self.faithful:
+            x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
-        x = nn.relu(x)
+        if not self.faithful:
+            x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
         x = nn.relu(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
-        return _head(x, self.faithful_head)
+        return _head(x, self.faithful)
 
 
 class Model1(_ReferenceCNN):
@@ -82,7 +91,7 @@ class MLP(nn.Module):
 
     hidden: Sequence[int] = (200, 200)
     num_classes: int = 10
-    faithful_head: bool = False
+    faithful: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -91,7 +100,7 @@ class MLP(nn.Module):
         for i, h in enumerate(self.hidden):
             x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"fc{i+1}")(x))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
-        return _head(x, self.faithful_head)
+        return _head(x, self.faithful)
 
 
 class LogisticRegression(nn.Module):
@@ -99,14 +108,14 @@ class LogisticRegression(nn.Module):
     16-worker ADMM on a9a).  The ℓ2 term lives in the loss, not here."""
 
     num_classes: int = 2
-    faithful_head: bool = False
+    faithful: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         x = x.astype(self.dtype).reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)
-        return _head(x, self.faithful_head)
+        return _head(x, self.faithful)
 
 
 class ResidualBlock(nn.Module):
@@ -145,7 +154,7 @@ class ResNet18(nn.Module):
     """
 
     num_classes: int = 10
-    faithful_head: bool = False
+    faithful: bool = False
     dtype: Any = jnp.float32
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
 
@@ -162,7 +171,7 @@ class ResNet18(nn.Module):
                 x = ResidualBlock(features, strides=strides, dtype=self.dtype)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
-        return _head(x, self.faithful_head)
+        return _head(x, self.faithful)
 
 
 _ZOO = {
@@ -178,13 +187,13 @@ def build_model(
     name: str,
     *,
     num_classes: int = 10,
-    faithful_head: bool | None = None,
+    faithful: bool | None = None,
     dtype: Any = jnp.float32,
 ) -> nn.Module:
     """Model dispatch by name — the typed replacement for the reference's
     if/elif on ``args.model`` (``servers.py:33-40``, ``simulators.py:31-38``).
 
-    ``faithful_head=None`` keeps each model's own default: True only for
+    ``faithful=None`` keeps each model's own default: True only for
     the two reference CNNs (which have a double-softmax to be faithful
     to), False for mlp/logistic/resnet18 (new models, corrected head).
     """
@@ -192,8 +201,8 @@ def build_model(
     if key not in _ZOO:
         raise ValueError(f"unknown model {name!r}; one of {sorted(_ZOO)}")
     kwargs: dict[str, Any] = dict(num_classes=num_classes, dtype=dtype)
-    if faithful_head is not None:
-        kwargs["faithful_head"] = faithful_head
+    if faithful is not None:
+        kwargs["faithful"] = faithful
     return _ZOO[key](**kwargs)
 
 

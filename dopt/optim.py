@@ -1,0 +1,62 @@
+"""Local optimizer as pure functions (torch-SGD semantics).
+
+The reference trains every client with ``torch.optim.SGD(lr, momentum)``
+(``Decentralized Optimization/src/clients.py:13``,
+``Distributed Optimization/src/clients.py:15-16``).  Torch's momentum
+update is
+
+    buf ← momentum·buf + grad        (buf starts at grad on first step)
+    p   ← p − lr·buf
+
+(no dampening, no Nesterov) — note this differs from the classic
+"velocity" form ``v ← mu·v − lr·g``; optax's ``trace`` matches torch,
+but we implement the two-liner directly so the oracle comparison has no
+third-party indirection.  Zero-initialised buffers are exactly
+equivalent to torch's lazy buf-starts-at-grad initialisation.
+
+FedProx / FedADMM enter as *gradient edits* before the momentum update,
+exactly where the reference mutates ``param.grad``
+(``clients.py:111`` prox, ``clients.py:135`` admm):
+
+    prox:  g ← g + rho·(p − theta)
+    admm:  g ← g + alpha + rho·(p − theta)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    momentum: jax.Array | dict  # pytree matching params
+
+
+def init_sgd(params) -> SGDState:
+    return SGDState(momentum=jax.tree.map(jnp.zeros_like, params))
+
+
+def sgd_step(params, state: SGDState, grads, *, lr: float, momentum: float):
+    """One torch-semantics SGD step. Returns (new_params, new_state)."""
+    new_buf = jax.tree.map(lambda m, g: momentum * m + g, state.momentum, grads)
+    new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_buf)
+    return new_params, SGDState(momentum=new_buf)
+
+
+def prox_grad_edit(grads, params, theta, rho: float):
+    """FedProx: g + rho*(p - theta)  (reference clients.py:111)."""
+    return jax.tree.map(lambda g, p, t: g + rho * (p - t), grads, params, theta)
+
+
+def admm_grad_edit(grads, params, theta, alpha, rho: float):
+    """FedADMM: g + alpha + rho*(p - theta)  (reference clients.py:135)."""
+    return jax.tree.map(
+        lambda g, p, t, a: g + a + rho * (p - t), grads, params, theta, alpha
+    )
+
+
+def admm_dual_ascent(alpha, params, theta, rho: float):
+    """After local epochs: alpha + rho*(p - theta)  (reference clients.py:141-144)."""
+    return jax.tree.map(lambda a, p, t: a + rho * (p - t), alpha, params, theta)

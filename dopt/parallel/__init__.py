@@ -1,0 +1,11 @@
+from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.collectives import masked_average, mix_dense, mix_shifts_shardmap
+
+__all__ = [
+    "make_mesh",
+    "shard_worker_tree",
+    "worker_sharding",
+    "masked_average",
+    "mix_dense",
+    "mix_shifts_shardmap",
+]

@@ -1,0 +1,4 @@
+from dopt.utils.metrics import History
+from dopt.utils.prng import setup_seed
+
+__all__ = ["History", "setup_seed"]

@@ -1,0 +1,93 @@
+"""Structured metrics sink (the reference's ``history`` pattern, typed).
+
+Every reference orchestrator appends per-round dicts to ``history``
+(``servers.py:77``, ``simulators.py:99-108``) and the notebooks dump
+them to CSV (``results/*.csv``, columns
+``round, avg_test_acc, avg_test_loss, avg_train_loss``).  ``History``
+is one sink with both schemas: P1 federated
+(round, test_acc, test_loss, train_loss, train_acc) and P2 gossip
+(round, avg_test_acc, avg_test_loss, avg_train_loss); CSV export is
+byte-compatible with the committed result files' column layout.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+from typing import Any, Iterator
+
+
+class History:
+    """Append-only per-round record store with CSV/JSON export."""
+
+    def __init__(self, name: str = "history"):
+        self.name = name
+        self.rows: list[dict[str, Any]] = []
+
+    def append(self, **row: Any) -> None:
+        self.rows.append({k: _scalar(v) for k, v in row.items()})
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
+        return iter(self.rows)
+
+    def __getitem__(self, key: str) -> list[Any]:
+        """Column access: history['avg_test_acc'] -> list over rounds."""
+        return [r.get(key) for r in self.rows]
+
+    def last(self) -> dict[str, Any]:
+        return self.rows[-1] if self.rows else {}
+
+    def to_csv(self, path: str | Path) -> Path:
+        """Write rows in the reference results/*.csv layout (leading
+        unnamed index column, then the row keys)."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        cols = list(self.rows[0].keys()) if self.rows else []
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow([""] + cols)
+            for i, r in enumerate(self.rows):
+                w.writerow([i] + [r.get(c, "") for c in cols])
+        return path
+
+    def to_json(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.rows, indent=2))
+        return path
+
+    @classmethod
+    def from_csv(cls, path: str | Path, name: str = "history") -> "History":
+        h = cls(name)
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f)
+            for row in reader:
+                h.rows.append({
+                    k: _maybe_num(v) for k, v in row.items() if k not in ("", None)
+                })
+        return h
+
+
+def _scalar(v: Any) -> Any:
+    """Unwrap 0-d arrays / jax scalars so rows are plain JSON-able."""
+    try:
+        import numpy as np
+        if hasattr(v, "item") and getattr(v, "ndim", None) in (0, None):
+            return v.item()
+        if isinstance(v, np.generic):
+            return v.item()
+    except Exception:
+        pass
+    return v
+
+
+def _maybe_num(v: str) -> Any:
+    try:
+        f = float(v)
+        return int(f) if f.is_integer() and "." not in v else f
+    except (TypeError, ValueError):
+        return v

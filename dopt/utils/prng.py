@@ -1,0 +1,31 @@
+"""Deterministic seeding (reference ``setup_seed``, utils.py:53-58).
+
+The reference seeds torch/cuda/numpy/random globally.  The jax engine
+needs no global state: everything derives from explicit keys / seeded
+``np.random.Generator`` streams.  ``setup_seed`` remains for the torch
+oracle backend and for host-side numpy sampling.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+def setup_seed(seed: int) -> None:
+    """Seed every global RNG the oracle backend touches."""
+    random.seed(seed)
+    np.random.seed(seed)
+    try:
+        import torch
+
+        torch.manual_seed(seed)
+        torch.backends.cudnn.deterministic = True  # no-op on CPU; faithful
+    except ImportError:
+        pass
+
+
+def host_rng(seed: int, *salts: int) -> np.random.Generator:
+    """Named deterministic numpy stream (client sampling, matchings...)."""
+    return np.random.default_rng(np.random.SeedSequence([seed, *salts]))

@@ -119,3 +119,19 @@ def test_eval_batches_mask():
     ex, ey, ew = eval_batches(ds.test_x, ds.test_y, batch_size=32)
     assert ex.shape == (2, 32, 28, 28, 1)
     assert ew.sum() == 50
+
+
+def test_finder_prefers_matching_dataset_dir(tmp_path):
+    # torchvision-style shared root: MNIST/raw and FashionMNIST/raw hold
+    # identically-named IDX files; 'mnist' must resolve to MNIST's.
+    from dopt.data.datasets import _Finder
+    for d in ("MNIST/raw", "FashionMNIST/raw"):
+        p = tmp_path / d
+        p.mkdir(parents=True)
+        (p / "train-images-idx3-ubyte").write_bytes(b"x")
+    f = _Finder(tmp_path, prefer=("mnist",), avoid=("fashion", "fmnist"))
+    hit = f.find(["train-images-idx3-ubyte"])
+    assert "FashionMNIST" not in str(hit)
+    f2 = _Finder(tmp_path, prefer=("fashion", "fmnist"))
+    hit2 = f2.find(["train-images-idx3-ubyte"])
+    assert "FashionMNIST" in str(hit2)

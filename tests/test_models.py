@@ -25,8 +25,8 @@ def test_model3_param_count_parity():
     assert count_params(params) == 1_105_098
 
 
-def test_faithful_head_returns_probabilities():
-    m = build_model("model1", faithful_head=True)
+def test_faithful_returns_probabilities():
+    m = build_model("model1", faithful=True)
     params = _init(m, (28, 28, 1))
     out = m.apply({"params": params}, jnp.ones((4, 28, 28, 1)))
     np.testing.assert_allclose(np.sum(out, axis=-1), 1.0, rtol=1e-5)
@@ -34,7 +34,7 @@ def test_faithful_head_returns_probabilities():
 
 
 def test_corrected_head_returns_logits():
-    m = build_model("model1", faithful_head=False)
+    m = build_model("model1", faithful=False)
     params = _init(m, (28, 28, 1))
     out = m.apply({"params": params}, jnp.ones((4, 28, 28, 1)))
     assert not np.allclose(np.sum(out, axis=-1), 1.0)
@@ -67,10 +67,10 @@ def test_accuracy_mask():
 
 
 def test_mlp_and_logistic():
-    m = build_model("mlp", faithful_head=False)
+    m = build_model("mlp", faithful=False)
     p = _init(m, (28, 28, 1))
     assert m.apply({"params": p}, jnp.ones((2, 28, 28, 1))).shape == (2, 10)
-    lr = build_model("logistic", num_classes=2, faithful_head=False)
+    lr = build_model("logistic", num_classes=2, faithful=False)
     plr = _init(lr, (123,))
     assert lr.apply({"params": plr}, jnp.ones((2, 123))).shape == (2, 2)
     assert count_params(plr) == 123 * 2 + 2
@@ -78,7 +78,7 @@ def test_mlp_and_logistic():
 
 
 def test_resnet18_forward():
-    m = build_model("resnet18", faithful_head=False)
+    m = build_model("resnet18", faithful=False)
     p = _init(m, (32, 32, 3))
     n = count_params(p)
     assert 10_000_000 < n < 12_000_000, n  # ~11.2M standard ResNet-18
@@ -89,3 +89,27 @@ def test_resnet18_forward():
 def test_build_model_unknown():
     with pytest.raises(ValueError, match="unknown model"):
         build_model("model2")
+
+
+def test_faithful_conv_stack_has_no_activations():
+    # The reference conv block is conv->pool->conv->pool with NO ReLU
+    # (models.py:10-15); a linear conv stack commutes with scaling.
+    import jax
+    import jax.numpy as jnp
+    m = build_model("model1", faithful=True)
+    p = m.init(jax.random.key(1), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def conv_features(x):
+        # run only the conv stack by zeroing fc contributions: compare
+        # pre-logit linearity via the full model on scaled inputs with
+        # zeroed biases instead: simpler — check conv1/conv2 outputs
+        # directly through a sliced apply.
+        return x
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 28, 28, 1)), jnp.float32)
+    # Idiomatic variant with the SAME params gives different outputs
+    # (ReLU between convs) — guards against silently re-adding conv ReLUs.
+    m2 = build_model("model1", faithful=False)
+    out1 = m.apply({"params": p}, x)
+    out2 = m2.apply({"params": p}, x)
+    assert not np.allclose(np.asarray(out1), np.asarray(jax.nn.softmax(out2)), atol=1e-4)
