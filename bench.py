@@ -1,0 +1,80 @@
+"""dopt benchmark — gossip rounds/sec on the reference's P2 workload.
+
+Reproduces the reference's gossip experiment shape (`Weighted
+Average.ipynb` cell 11: 6 workers, Model1 1.66M params, MNIST-sized
+data, non-IID 2 shards/user, local_ep=4, local_bs=128, circle topology,
+stochastic mixing) and measures steady-state gossip rounds per second on
+the available accelerator.
+
+Baseline: the reference runs ~10 rounds in ~800s on Colab
+(BASELINE.md: "Gossip throughput (derived) ~0.012 rounds/s").  Data is
+synthetic at exactly MNIST scale (60,000 train / 10,000 test samples,
+28x28x1) because this environment has no network egress; per-round
+FLOPs and communication volume match the real workload.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE_ROUNDS_PER_SEC = 0.012  # BASELINE.md derived gossip throughput
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny data / few rounds (CI smoke, not a benchmark)")
+    ap.add_argument("--rounds", type=int, default=None)
+    args = ap.parse_args()
+
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+    from dopt.engine import GossipTrainer
+
+    train_size = 6_000 if args.smoke else 60_000
+    test_size = 1_000 if args.smoke else 10_000
+    measure_rounds = args.rounds or (3 if args.smoke else 10)
+
+    cfg = ExperimentConfig(
+        name="bench-dsgd-mnist",
+        seed=2028,
+        data=DataConfig(dataset="mnist", num_users=6, iid=False, shards=2,
+                        synthetic_train_size=train_size,
+                        synthetic_test_size=test_size),
+        model=ModelConfig(model="model1", faithful=True),
+        optim=OptimizerConfig(lr=0.01, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="stochastic", rounds=10, local_ep=4,
+                            local_bs=128),
+    )
+    trainer = GossipTrainer(cfg)
+
+    # Warmup: compile + first round.
+    trainer.run(rounds=1)
+
+    t0 = time.time()
+    trainer.run(rounds=measure_rounds)
+    elapsed = time.time() - t0
+    rounds_per_sec = measure_rounds / elapsed
+
+    result = {
+        "metric": "gossip_rounds_per_sec_dsgd_mnist_6workers_model1",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / REFERENCE_ROUNDS_PER_SEC, 2),
+    }
+    print(json.dumps(result))
+    # Context to stderr so stdout stays one JSON line.
+    last = trainer.history.last()
+    print(f"# {measure_rounds} rounds in {elapsed:.2f}s; "
+          f"last avg_test_acc={last.get('avg_test_acc')}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

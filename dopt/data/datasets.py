@@ -77,10 +77,16 @@ class _Finder:
     mention the requested dataset wins."""
 
     def __init__(self, data_dir: Path, prefer: tuple[str, ...] = (),
-                 avoid: tuple[str, ...] = ()):
+                 avoid=()):
+        """``avoid`` is a tuple of substrings or a predicate on the
+        lower-cased path string; avoided-only hits count as missing."""
         self.data_dir = data_dir
         self.prefer = tuple(t.lower() for t in prefer)
-        self.avoid = tuple(t.lower() for t in avoid)
+        if callable(avoid):
+            self._avoided = avoid
+        else:
+            toks = tuple(t.lower() for t in avoid)
+            self._avoided = (lambda s: any(t in s for t in toks)) if toks else (lambda s: False)
         self._table: dict[str, list[Path]] | None = None
 
     def _listing(self) -> dict[str, list[Path]]:
@@ -95,8 +101,7 @@ class _Finder:
     def _rank(self, p: Path) -> tuple[int, int]:
         s = str(p).lower()
         preferred = any(t in s for t in self.prefer)
-        avoided = any(t in s for t in self.avoid)
-        return (0 if preferred else 1, 1 if avoided else 0)
+        return (0 if preferred else 1, 1 if self._avoided(s) else 0)
 
     def find(self, names: list[str]) -> Path | None:
         for name in names:
@@ -106,12 +111,11 @@ class _Finder:
             table = self._listing()
             hits = table.get(name, []) + table.get(name + ".gz", [])
             if hits:
-                best = min(hits, key=self._rank)
-                if self.avoid and self._rank(best)[1] and len(hits) == 1:
-                    # only hit sits under an avoided name -> likely the
-                    # wrong dataset's file; treat as missing
+                if all(self._avoided(str(h).lower()) for h in hits):
+                    # every hit sits under an avoided name -> the wrong
+                    # dataset's files; treat as missing
                     continue
-                return best
+                return min(hits, key=self._rank)
         return None
 
 
@@ -129,7 +133,12 @@ def _load_mnist_like(name: str, data_dir: Path) -> Dataset | None:
     if name == "mnist":
         finder = _Finder(data_dir, prefer=("mnist",), avoid=("fashion", "fmnist"))
     else:
-        finder = _Finder(data_dir, prefer=("fashion", "fmnist"))
+        # "mnist" is a substring of "fashionmnist", so express the avoid
+        # rule as a predicate: a path that mentions mnist but not fashion.
+        finder = _Finder(
+            data_dir, prefer=("fashion", "fmnist"),
+            avoid=lambda s: "mnist" in s and "fashion" not in s and "fmnist" not in s,
+        )
     paths = {k: finder.find(v) for k, v in files.items()}
     if any(p is None for p in paths.values()):
         return None

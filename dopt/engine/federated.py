@@ -36,15 +36,11 @@ import numpy as np
 
 from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
-from dopt.engine.local import (
-    make_evaluator,
-    make_stacked_evaluator,
-    make_stacked_local_update,
-)
+from dopt.engine.local import make_evaluator, make_stacked_local_update
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent
 from dopt.parallel.collectives import broadcast_to_workers, masked_average
-from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
 from dopt.utils.prng import host_rng
 
@@ -73,8 +69,7 @@ class FederatedTrainer:
 
         w = cfg.data.num_users
         self.num_workers = w
-        from dopt.engine.gossip import _mesh_devices_for
-        self.mesh = make_mesh(_mesh_devices_for(w, cfg.mesh_devices))
+        self.mesh = make_mesh(fit_mesh_devices(w, cfg.mesh_devices))
         self._sharding = worker_sharding(self.mesh)
 
         self.dataset = load_dataset(
@@ -99,10 +94,10 @@ class FederatedTrainer:
         pad = steps * bs - l
         ti = np.concatenate([self.index_matrix,
                              self.index_matrix[:, :pad]], axis=1)
-        self._train_eval_idx = ti.reshape(w, steps, bs)
+        self._train_eval_idx = jnp.asarray(ti.reshape(w, steps, bs))
         tw = np.concatenate([np.ones((w, l), np.float32),
                              np.zeros((w, pad), np.float32)], axis=1)
-        self._train_eval_w = tw.reshape(w, steps, bs)
+        self._train_eval_w = jnp.asarray(tw.reshape(w, steps, bs))
 
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
@@ -129,7 +124,6 @@ class FederatedTrainer:
             rho=cfg.optim.rho,
         )
         global_eval = make_evaluator(self.model.apply)
-        stacked_eval = make_stacked_evaluator(self.model.apply)
         algorithm = f.algorithm
         rho = cfg.optim.rho
         eval_train_flag = eval_train
@@ -208,7 +202,7 @@ class FederatedTrainer:
                 self.theta, self.params, self.momentum, duals_in,
                 jnp.asarray(mask), idx, bweight,
                 self._train_x, self._train_y, *self._eval,
-                jnp.asarray(self._train_eval_idx), jnp.asarray(self._train_eval_w),
+                self._train_eval_idx, self._train_eval_w,
             )
             if self.duals is not None:
                 self.duals = new_duals

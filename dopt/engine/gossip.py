@@ -34,19 +34,11 @@ from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import make_stacked_evaluator, make_stacked_local_update
 from dopt.models import build_model, count_params
-from dopt.parallel.collectives import broadcast_to_workers, mix_dense
-from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.collectives import broadcast_to_workers, mix_power
+from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.topology import MixingMatrices, build_mixing_matrices
 from dopt.utils.metrics import History
 from dopt.utils.prng import host_rng
-
-
-def _mesh_devices_for(num_workers: int, requested: int | None) -> int:
-    avail = len(jax.devices()) if requested is None else requested
-    d = min(num_workers, avail)
-    while num_workers % d:
-        d -= 1
-    return d
 
 
 def random_matching_matrix(n: int, rng: np.random.Generator) -> np.ndarray:
@@ -104,7 +96,7 @@ class GossipTrainer:
 
         w = cfg.data.num_users
         self.num_workers = w
-        self.mesh = make_mesh(_mesh_devices_for(w, cfg.mesh_devices))
+        self.mesh = make_mesh(fit_mesh_devices(w, cfg.mesh_devices))
 
         # Data: load, partition, upload once.
         self.dataset = load_dataset(
@@ -161,8 +153,7 @@ class GossipTrainer:
         def round_fn(params, mom, w_matrix, idx, bweight, train_x, train_y,
                      ex, ey, ew, do_eval):
             if do_mix:
-                for _ in range(eps):
-                    params = mix_dense(params, w_matrix, mesh)
+                params = mix_power(params, w_matrix, eps=eps, mesh=mesh)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),

@@ -28,8 +28,6 @@ mask instead of Python-side client selection.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -126,13 +124,15 @@ def broadcast_to_workers(tree, num_workers: int):
     )
 
 
-@partial(jax.jit, static_argnames=("eps",))
-def mix_power(stacked, w_matrix, eps: int = 1):
+def mix_power(stacked, w_matrix, eps: int = 1, mesh: Mesh | None = None):
     """eps consensus sweeps (FedLCon, simulators.py:182-212 — with the
     stale-accumulation bug fixed: each sweep reads the previous sweep's
-    output)."""
+    output).  eps=1 is plain consensus; jit at the caller."""
+    if eps == 1:
+        return mix_dense(stacked, w_matrix, mesh)
+
     def body(x, _):
-        return mix_dense(x, w_matrix), None
+        return mix_dense(x, w_matrix, mesh), None
 
     out, _ = jax.lax.scan(body, stacked, None, length=eps)
     return out

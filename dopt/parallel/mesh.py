@@ -29,6 +29,16 @@ def make_mesh(num_devices: int | None = None, *, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (WORKER_AXIS,))
 
 
+def fit_mesh_devices(num_workers: int, requested: int | None = None) -> int:
+    """Largest device count <= min(workers, available) that divides the
+    worker count evenly (workers fold onto devices in equal lanes)."""
+    avail = len(jax.devices()) if requested is None else requested
+    d = min(num_workers, avail)
+    while num_workers % d:
+        d -= 1
+    return d
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (worker) axis across the mesh; everything else
     replicated within a worker shard."""

@@ -99,13 +99,6 @@ def test_faithful_conv_stack_has_no_activations():
     m = build_model("model1", faithful=True)
     p = m.init(jax.random.key(1), jnp.zeros((1, 28, 28, 1)))["params"]
 
-    def conv_features(x):
-        # run only the conv stack by zeroing fc contributions: compare
-        # pre-logit linearity via the full model on scaled inputs with
-        # zeroed biases instead: simpler — check conv1/conv2 outputs
-        # directly through a sliced apply.
-        return x
-
     x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 28, 28, 1)), jnp.float32)
     # Idiomatic variant with the SAME params gives different outputs
     # (ReLU between convs) — guards against silently re-adding conv ReLUs.
