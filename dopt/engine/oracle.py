@@ -1,0 +1,232 @@
+"""Faithful torch-CPU oracle backend.
+
+A from-scratch PyTorch implementation of the reference's exact training
+numerics (NOT a copy of the reference code — same math, written against
+SURVEY.md's semantics inventory), used as the step-level ground truth
+for the jax engine:
+
+* Models: the faithful architectures (conv stack with NO activations,
+  ReLU only between the Dense layers, Softmax head —
+  ``models.py:6-27`` / ``:31-51``), NCHW like torch wants.
+* Local update: ``torch.optim.SGD(lr, momentum)`` epochs over the SAME
+  deterministic batch plan the jax engine consumes
+  (``clients.py:36-53`` P1 / ``:34-59`` P2).
+* FedProx/FedADMM: the reference's in-place ``param.grad`` edits
+  (``clients.py:111``, ``:135``, ``:141-144``).
+* Consensus: weighted state-dict sum ``w_i ← Σ_j a_ij w_j``
+  (``clients.py:61-69`` P2).
+
+Precision note: parity is validated jax-CPU vs torch-CPU (agreement
+~1e-5).  On TPU, fp32 matmuls/convs use reduced internal precision by
+default (bf16 passes), so TPU-vs-oracle agreement is ~5e-4 on
+probabilities; set ``jax_default_matmul_precision=highest`` for strict
+TPU-side comparisons at a throughput cost.
+
+Parameter conversion handles the NHWC↔NCHW layout difference: flax conv
+kernels are [H, W, I, O] vs torch [O, I, H, W], flax dense [in, out] vs
+torch [out, in], and the first dense layer's input ordering differs
+because the reference flattens NCHW channel-major while the flax model
+flattens NHWC (``models.py:24`` vs ``dopt.models.zoo``).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+try:
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    HAVE_TORCH = True
+except ImportError:  # pragma: no cover - torch is in the image
+    HAVE_TORCH = False
+
+
+# ---------------------------------------------------------------------
+# Faithful torch models (NCHW)
+# ---------------------------------------------------------------------
+
+def torch_reference_cnn(in_channels: int, spatial: int, hidden: int,
+                        num_classes: int = 10, faithful: bool = True):
+    """The reference CNN shape: conv(k5,p2)→pool→conv(k5,p2)→pool→
+    Dense(hidden)→ReLU→Dense(classes)[→Softmax]."""
+    flat = (spatial // 4) ** 2 * 64
+
+    class _Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(in_channels, 32, 5, padding=2)
+            self.conv2 = nn.Conv2d(32, 64, 5, padding=2)
+            self.fc1 = nn.Linear(flat, hidden)
+            self.fc2 = nn.Linear(hidden, num_classes)
+
+        def forward(self, x):
+            x = self.conv1(x)
+            if not faithful:
+                x = F.relu(x)
+            x = F.max_pool2d(x, 2)
+            x = self.conv2(x)
+            if not faithful:
+                x = F.relu(x)
+            x = F.max_pool2d(x, 2)
+            x = x.reshape(x.shape[0], -1)
+            x = F.relu(self.fc1(x))
+            x = self.fc2(x)
+            return F.softmax(x, dim=-1) if faithful else x
+
+    return _Net()
+
+
+# ---------------------------------------------------------------------
+# Parameter conversion (flax pytree <-> torch state_dict)
+# ---------------------------------------------------------------------
+
+def _conv_to_torch(k: np.ndarray) -> np.ndarray:
+    return np.transpose(k, (3, 2, 0, 1))  # [H,W,I,O] -> [O,I,H,W]
+
+
+def _dense_to_torch(k: np.ndarray) -> np.ndarray:
+    return np.transpose(k)  # [in,out] -> [out,in]
+
+
+def _fc1_to_torch(k: np.ndarray, spatial: int, channels: int = 64) -> np.ndarray:
+    """First dense after flatten: reorder flax's HWC input ordering to
+    torch's CHW before transposing."""
+    s = spatial // 4
+    out = k.shape[1]
+    k = k.reshape(s, s, channels, out)          # [H,W,C,out]
+    k = np.transpose(k, (2, 0, 1, 3))           # [C,H,W,out]
+    return np.transpose(k.reshape(s * s * channels, out))  # [out, CHW]
+
+
+def flax_cnn_params_to_torch(params: Mapping, spatial: int) -> dict[str, "torch.Tensor"]:
+    """Convert a dopt Model1/Model3 flax param tree into the faithful
+    torch model's state_dict."""
+    t = torch.from_numpy
+    p = {k: np.asarray(v) for k, v in _flatten2(params).items()}
+    return {
+        "conv1.weight": t(_conv_to_torch(p["conv1.kernel"]).copy()),
+        "conv1.bias": t(p["conv1.bias"].copy()),
+        "conv2.weight": t(_conv_to_torch(p["conv2.kernel"]).copy()),
+        "conv2.bias": t(p["conv2.bias"].copy()),
+        "fc1.weight": t(_fc1_to_torch(p["fc1.kernel"], spatial).copy()),
+        "fc1.bias": t(p["fc1.bias"].copy()),
+        "fc2.weight": t(_dense_to_torch(p["fc2.kernel"]).copy()),
+        "fc2.bias": t(p["fc2.bias"].copy()),
+    }
+
+
+def torch_cnn_params_to_flax(state: Mapping[str, "torch.Tensor"], spatial: int):
+    """Inverse conversion, for loading oracle results back into jax."""
+    s = spatial // 4
+
+    def fc1_to_flax(w: np.ndarray) -> np.ndarray:
+        out = w.shape[0]
+        k = w.T.reshape(64, s, s, out)          # [C,H,W,out]
+        k = np.transpose(k, (1, 2, 0, 3))       # [H,W,C,out]
+        return k.reshape(s * s * 64, out)
+
+    g = {k: v.detach().cpu().numpy() for k, v in state.items()}
+    return {
+        "conv1": {"kernel": np.transpose(g["conv1.weight"], (2, 3, 1, 0)),
+                  "bias": g["conv1.bias"]},
+        "conv2": {"kernel": np.transpose(g["conv2.weight"], (2, 3, 1, 0)),
+                  "bias": g["conv2.bias"]},
+        "fc1": {"kernel": fc1_to_flax(g["fc1.weight"]), "bias": g["fc1.bias"]},
+        "fc2": {"kernel": np.transpose(g["fc2.weight"]), "bias": g["fc2.bias"]},
+    }
+
+
+def _flatten2(tree, prefix="") -> dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        if isinstance(v, Mapping):
+            out.update(_flatten2(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+# ---------------------------------------------------------------------
+# Oracle worker: reference-exact local training
+# ---------------------------------------------------------------------
+
+class OracleWorker:
+    """One reference client: model + persistent SGD optimizer.
+
+    The optimizer lives for the worker's lifetime (its momentum buffers
+    survive consensus/theta loads), matching ``Client.__init__``
+    creating the optimizer once.
+    """
+
+    def __init__(self, model: "nn.Module", *, lr: float, momentum: float,
+                 rho: float = 0.0, algorithm: str = "sgd"):
+        assert HAVE_TORCH
+        self.model = model
+        self.optimizer = torch.optim.SGD(model.parameters(), lr=lr,
+                                         momentum=momentum)
+        self.rho = rho
+        self.algorithm = algorithm
+        if algorithm == "fedadmm":
+            self.alpha = {n: torch.zeros_like(p)
+                          for n, p in model.named_parameters()}
+
+    def load(self, state: Mapping[str, "torch.Tensor"]) -> None:
+        self.model.load_state_dict({k: v.clone() for k, v in state.items()})
+
+    def state(self) -> dict[str, "torch.Tensor"]:
+        return {k: v.clone() for k, v in self.model.state_dict().items()}
+
+    def local_update(self, bx: np.ndarray, by: np.ndarray, bw: np.ndarray,
+                     theta: Mapping | None = None) -> float:
+        """Run the batch-plan steps: bx [S,B,C,H,W] (NCHW), by [S,B],
+        bw [S,B] padding weights.  Returns mean loss."""
+        losses = []
+        theta_t = ({k: v.detach().clone() for k, v in theta.items()}
+                   if theta is not None else None)
+        for s in range(bx.shape[0]):
+            x = torch.from_numpy(np.ascontiguousarray(bx[s]))
+            y = torch.from_numpy(np.ascontiguousarray(by[s])).long()
+            w = torch.from_numpy(np.ascontiguousarray(bw[s]))
+            self.optimizer.zero_grad()
+            out = self.model(x)
+            per = F.cross_entropy(out, y, reduction="none")
+            loss = (per * w).sum() / w.sum().clamp(min=1.0)
+            loss.backward()
+            if self.algorithm in ("fedprox", "fedadmm"):
+                for n, p in self.model.named_parameters():
+                    if p.grad is None:
+                        continue
+                    extra = self.rho * (p.detach() - theta_t[n])
+                    if self.algorithm == "fedadmm":
+                        extra = extra + self.alpha[n]
+                    p.grad = p.grad + extra
+            self.optimizer.step()
+            losses.append(float(loss.detach()))
+        return float(np.mean(losses))
+
+    def update_duals(self, theta: Mapping) -> None:
+        """ADMM dual ascent after the local epochs (clients.py:141-144)."""
+        with torch.no_grad():
+            for n, p in self.model.named_parameters():
+                self.alpha[n] = self.alpha[n] + self.rho * (p - theta[n])
+
+
+def consensus(neighbor_states: list[tuple[float, Mapping]]) -> dict:
+    """w ← Σ_j a_j · state_j (reference ``Client.consensus``,
+    clients.py:61-69): plain weighted sum, NO implicit self term."""
+    out: dict = {}
+    for a, st in neighbor_states:
+        for k, v in st.items():
+            acc = out.get(k)
+            out[k] = a * v if acc is None else acc + a * v
+    return out
+
+
+def nhwc_to_nchw(x: np.ndarray) -> np.ndarray:
+    """Batch-plan features [..., H, W, C] -> [..., C, H, W]."""
+    return np.moveaxis(x, -1, -3)
