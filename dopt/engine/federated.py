@@ -42,6 +42,7 @@ from dopt.optim import admm_dual_ascent
 from dopt.parallel.collectives import broadcast_to_workers, masked_average
 from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
+from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
 
 
@@ -66,6 +67,7 @@ class FederatedTrainer:
         self.eval_train = eval_train
         self.round = 0
         self.history = History(cfg.name)
+        self.timers = PhaseTimers()
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -189,16 +191,18 @@ class FederatedTrainer:
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
-            mask = self.sample_clients(frac)
-            plan = make_batch_plan(
-                self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
-                seed=cfg.seed, round_idx=t,
-            )
-            idx = jax.device_put(plan.idx, self._sharding)
-            bweight = jax.device_put(plan.weight, self._sharding)
+            with self.timers.phase("host_batch_plan"):
+                mask = self.sample_clients(frac)
+                plan = make_batch_plan(
+                    self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
+                    seed=cfg.seed, round_idx=t,
+                )
+                idx = jax.device_put(plan.idx, self._sharding)
+                bweight = jax.device_put(plan.weight, self._sharding)
             duals_in = self.duals if self.duals is not None else {}
             (self.theta, self.params, self.momentum, new_duals,
-             local_loss, evalm, trainm) = self._round_fn(
+             local_loss, evalm, trainm) = self.timers.measure(
+                "round_step", self._round_fn,
                 self.theta, self.params, self.momentum, duals_in,
                 jnp.asarray(mask), idx, bweight,
                 self._train_x, self._train_y, *self._eval,
@@ -217,6 +221,46 @@ class FederatedTrainer:
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
+
+    def save(self, path) -> None:
+        """Checkpoint (theta, stacked params, momentum, duals, round,
+        history, sampling-RNG state).  Persisting the RNG state makes a
+        resumed run draw the SAME client samples a continuous run would
+        — without it, round t after resume replays round 0's sample."""
+        from dopt.utils.checkpoint import save_checkpoint
+
+        arrays = {"theta": self.theta, "params": self.params,
+                  "momentum": self.momentum}
+        if self.duals is not None:
+            arrays["duals"] = self.duals
+        save_checkpoint(
+            path, arrays=arrays,
+            meta={"round": self.round, "name": self.cfg.name,
+                  "algorithm": self.cfg.federated.algorithm,
+                  "history": self.history.rows,
+                  "sample_rng_state": self._sample_rng.bit_generator.state},
+        )
+
+    def restore(self, path) -> None:
+        from dopt.utils.checkpoint import load_checkpoint
+
+        arrays, meta = load_checkpoint(path)
+        if meta.get("algorithm") != self.cfg.federated.algorithm:
+            raise ValueError(
+                f"checkpoint is for algorithm {meta.get('algorithm')!r}, "
+                f"trainer runs {self.cfg.federated.algorithm!r}"
+            )
+        if self.duals is not None and "duals" not in arrays:
+            raise ValueError("fedadmm trainer requires duals in the checkpoint")
+        self.theta = arrays["theta"]
+        self.params = shard_worker_tree(arrays["params"], self.mesh)
+        self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
+        if "duals" in arrays and self.duals is not None:
+            self.duals = shard_worker_tree(arrays["duals"], self.mesh)
+        self.round = int(meta["round"])
+        self.history.rows = list(meta.get("history", []))
+        if meta.get("sample_rng_state"):
+            self._sample_rng.bit_generator.state = meta["sample_rng_state"]
 
     def evaluate_global(self) -> dict[str, float]:
         out = self._global_eval(self.theta, *self._eval)

@@ -38,6 +38,7 @@ from dopt.parallel.collectives import broadcast_to_workers, mix_power
 from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.topology import MixingMatrices, build_mixing_matrices
 from dopt.utils.metrics import History
+from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
 
 
@@ -93,6 +94,7 @@ class GossipTrainer:
         self.eval_every = eval_every
         self.round = 0
         self.history = History(cfg.name)
+        self.timers = PhaseTimers()
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -190,17 +192,21 @@ class GossipTrainer:
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
-            w_t = self._matrix_for_round(t)
-            plan = make_batch_plan(
-                self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
-                seed=cfg.seed, round_idx=t,
-            )
-            idx = jax.device_put(plan.idx, self._sharding)
-            bweight = jax.device_put(plan.weight, self._sharding)
+            with self.timers.phase("host_batch_plan"):
+                w_t = self._matrix_for_round(t)
+                plan = make_batch_plan(
+                    self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
+                    seed=cfg.seed, round_idx=t,
+                )
+                idx = jax.device_put(plan.idx, self._sharding)
+                bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
-            self.params, self.momentum, train_loss, train_acc, evalm = self._round_fn(
-                self.params, self.momentum, w_t, idx, bweight,
-                self._train_x, self._train_y, *self._eval, do_eval,
+            self.params, self.momentum, train_loss, train_acc, evalm = (
+                self.timers.measure(
+                    "round_step", self._round_fn,
+                    self.params, self.momentum, w_t, idx, bweight,
+                    self._train_x, self._train_y, *self._eval, do_eval,
+                )
             )
             row = {
                 "round": t,
@@ -214,6 +220,39 @@ class GossipTrainer:
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
+
+    # ------------------------------------------------------------------
+    def save(self, path) -> None:
+        """Checkpoint full training state: params, momentum, round,
+        history, AND host RNG state (the matching RNG is stateful — a
+        resumed 'gossip' run must not replay round-0 matchings)."""
+        from dopt.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            path,
+            arrays={"params": self.params, "momentum": self.momentum},
+            meta={"round": self.round, "name": self.cfg.name,
+                  "algorithm": self.cfg.gossip.algorithm,
+                  "history": self.history.rows,
+                  "matching_rng_state": self._matching_rng.bit_generator.state},
+        )
+
+    def restore(self, path) -> None:
+        """Resume from a checkpoint written by ``save`` (same config)."""
+        from dopt.utils.checkpoint import load_checkpoint
+
+        arrays, meta = load_checkpoint(path)
+        if meta.get("algorithm") != self.cfg.gossip.algorithm:
+            raise ValueError(
+                f"checkpoint is for algorithm {meta.get('algorithm')!r}, "
+                f"trainer runs {self.cfg.gossip.algorithm!r}"
+            )
+        self.params = shard_worker_tree(arrays["params"], self.mesh)
+        self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
+        self.round = int(meta["round"])
+        self.history.rows = list(meta.get("history", []))
+        if meta.get("matching_rng_state"):
+            self._matching_rng.bit_generator.state = meta["matching_rng_state"]
 
     # Convenience: per-worker eval of the current state.
     def evaluate(self) -> dict[str, np.ndarray]:

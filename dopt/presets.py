@@ -1,0 +1,168 @@
+"""Runnable experiment presets.
+
+Two families:
+
+* ``reference_*`` — the reference notebooks' experiment grid, typed
+  (P1 ``Primal and Dual Decomposition.ipynb`` cells 8-25: 100 users,
+  frac 0.1, 20 rounds, local_ep 10, bs 50, lr 0.1, rho 0.1, IID,
+  seed 2022; P2 ``Weighted Average.ipynb`` cells 11-36: 6 users,
+  10 rounds, local_ep 4, bs 128, lr 0.01, non-IID shards 2, seed 2028).
+* ``baseline_*`` — the five BASELINE.json benchmark configs for the
+  north-star targets.
+
+Dataset sizes default to the real datasets' scale; with no raw data on
+disk the loaders fall back to shape-compatible synthetic data, so every
+preset runs everywhere.
+"""
+
+from __future__ import annotations
+
+from dopt.config import (DataConfig, ExperimentConfig, FederatedConfig,
+                         GossipConfig, ModelConfig, OptimizerConfig)
+
+MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
+CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
+
+
+def _mnist_data(num_users: int, iid: bool, shards: int = 2) -> DataConfig:
+    return DataConfig(dataset="mnist", num_users=num_users, iid=iid,
+                      shards=shards, synthetic_train_size=MNIST_TRAIN,
+                      synthetic_test_size=MNIST_TEST)
+
+
+def _cifar_data(num_users: int, iid: bool, shards: int = 2) -> DataConfig:
+    return DataConfig(dataset="cifar10", num_users=num_users, iid=iid,
+                      shards=shards, synthetic_train_size=CIFAR_TRAIN,
+                      synthetic_test_size=CIFAR_TEST)
+
+
+# ---------------------------------------------------------------------
+# Reference notebook replays
+# ---------------------------------------------------------------------
+
+def reference_federated(algorithm: str = "fedavg") -> ExperimentConfig:
+    """P1 notebook setup (cells 8/10): FedAvg/FedProx/FedADMM, 100 users."""
+    return ExperimentConfig(
+        name=f"reference-{algorithm}", seed=2022,
+        data=_mnist_data(100, iid=True),
+        model=ModelConfig(model="model1", faithful=True),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5, rho=0.1),
+        federated=FederatedConfig(algorithm=algorithm, frac=0.1, rounds=20,
+                                  local_ep=10, local_bs=50),
+    )
+
+
+def reference_gossip(algorithm: str = "dsgd", topology: str = "circle",
+                     mode: str = "stochastic", iid: bool = False,
+                     eps: int = 1) -> ExperimentConfig:
+    """P2 notebook setup (cell 11): 6 workers, the topology/mode grid."""
+    return ExperimentConfig(
+        name=f"reference-{algorithm}-{topology}-{mode}", seed=2028,
+        data=_mnist_data(6, iid=iid),
+        model=ModelConfig(model="model1", faithful=True),
+        optim=OptimizerConfig(lr=0.01, momentum=0.5),
+        gossip=GossipConfig(algorithm=algorithm, topology=topology, mode=mode,
+                            rounds=10, local_ep=4, local_bs=128, eps=eps),
+    )
+
+
+# ---------------------------------------------------------------------
+# BASELINE.json benchmark configs
+# ---------------------------------------------------------------------
+
+def baseline_1_ring_mnist_mlp() -> ExperimentConfig:
+    """4-worker weighted-average consensus, ring mixing, MNIST MLP."""
+    return ExperimentConfig(
+        name="baseline1-ring-mnist-mlp", seed=2028,
+        data=_mnist_data(4, iid=False),
+        model=ModelConfig(model="mlp", faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=20, local_ep=2,
+                            local_bs=64),
+    )
+
+
+def baseline_2_dsgd_cifar_cnn() -> ExperimentConfig:
+    """16-worker D-SGD, doubly-stochastic mixing, CIFAR-10 small CNN."""
+    return ExperimentConfig(
+        name="baseline2-dsgd16-cifar-cnn", seed=1,
+        data=_cifar_data(16, iid=False),
+        model=ModelConfig(model="model3", faithful=False,
+                          input_shape=(32, 32, 3)),
+        optim=OptimizerConfig(lr=0.05, momentum=0.9),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="double_stochastic", rounds=100, local_ep=1,
+                            local_bs=64),
+    )
+
+
+def baseline_3_fedavg_noniid() -> ExperimentConfig:
+    """FedAvg primal decomposition, 16 non-IID clients, MNIST."""
+    return ExperimentConfig(
+        name="baseline3-fedavg16-noniid", seed=2022,
+        data=_mnist_data(16, iid=False),
+        model=ModelConfig(model="model1", faithful=True),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5),
+        federated=FederatedConfig(algorithm="fedavg", frac=0.5, rounds=30,
+                                  local_ep=5, local_bs=50),
+    )
+
+
+def baseline_4_admm_a9a() -> ExperimentConfig:
+    """ADMM dual decomposition, 16 workers, l2 logistic regression, a9a."""
+    return ExperimentConfig(
+        name="baseline4-admm16-a9a", seed=0,
+        data=DataConfig(dataset="a9a", num_users=16, iid=True,
+                        synthetic_train_size=32_561,
+                        synthetic_test_size=16_281),
+        model=ModelConfig(model="logistic", num_classes=2,
+                          input_shape=(123,), faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=0.0, rho=1.0),
+        federated=FederatedConfig(algorithm="fedadmm", frac=1.0, rounds=50,
+                                  local_ep=2, local_bs=128),
+    )
+
+
+def baseline_5_gossip32_resnet() -> ExperimentConfig:
+    """32-worker gossip SGD, ResNet-18 CIFAR-10, time-varying random graphs."""
+    return ExperimentConfig(
+        name="baseline5-gossip32-resnet18", seed=3,
+        data=_cifar_data(32, iid=False, shards=4),
+        model=ModelConfig(model="resnet18", faithful=False,
+                          input_shape=(32, 32, 3)),
+        optim=OptimizerConfig(lr=0.1, momentum=0.9),
+        gossip=GossipConfig(algorithm="dsgd", topology="random",
+                            mode="metropolis", rounds=200, local_ep=1,
+                            local_bs=64),
+    )
+
+
+PRESETS = {
+    "reference-fedavg": lambda: reference_federated("fedavg"),
+    "reference-fedprox": lambda: reference_federated("fedprox"),
+    "reference-fedadmm": lambda: reference_federated("fedadmm"),
+    "reference-centralized": lambda: reference_gossip("centralized"),
+    "reference-nocons-iid": lambda: reference_gossip("nocons", iid=True),
+    "reference-nocons-noniid": lambda: reference_gossip("nocons"),
+    "reference-dsgd-star": lambda: reference_gossip("dsgd", "star"),
+    "reference-dsgd-circle": lambda: reference_gossip("dsgd", "circle"),
+    "reference-dsgd-complete": lambda: reference_gossip("dsgd", "complete"),
+    "reference-dsgd-circle-double": lambda: reference_gossip(
+        "dsgd", "circle", "double_stochastic"),
+    "reference-dsgd-complete-double": lambda: reference_gossip(
+        "dsgd", "complete", "double_stochastic"),
+    "reference-fedlcon": lambda: reference_gossip("fedlcon", eps=5),
+    "reference-gossip": lambda: reference_gossip("gossip"),
+    "baseline1": baseline_1_ring_mnist_mlp,
+    "baseline2": baseline_2_dsgd_cifar_cnn,
+    "baseline3": baseline_3_fedavg_noniid,
+    "baseline4": baseline_4_admm_a9a,
+    "baseline5": baseline_5_gossip32_resnet,
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}; one of {sorted(PRESETS)}")
+    return PRESETS[name]()

@@ -1,0 +1,93 @@
+"""CLI experiment runner: ``python -m dopt.run --preset reference-fedavg``.
+
+The typed replacement for the reference's notebook driver cells: pick a
+preset (or override fields), run, print per-round metrics, export the
+history CSV in the reference's results layout, optionally checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_trainer(cfg):
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    if cfg.federated is not None:
+        return FederatedTrainer(cfg)
+    return GossipTrainer(cfg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", required=True,
+                    help="preset name (see dopt.presets.PRESETS) or 'list'")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override round count")
+    ap.add_argument("--num-users", type=int, default=None)
+    ap.add_argument("--synthetic-scale", type=float, default=None,
+                    help="scale synthetic dataset sizes (e.g. 0.1 for smoke)")
+    ap.add_argument("--csv", default=None, help="write history CSV here")
+    ap.add_argument("--checkpoint", default=None,
+                    help="save a checkpoint here after the run")
+    ap.add_argument("--resume", default=None,
+                    help="restore this checkpoint before running")
+    ap.add_argument("--timers", action="store_true",
+                    help="print phase-timer report")
+    args = ap.parse_args(argv)
+
+    from dopt.presets import PRESETS, get_preset
+
+    if args.preset == "list":
+        for name in sorted(PRESETS):
+            print(name)
+        return 0
+
+    import dataclasses
+
+    cfg = get_preset(args.preset)
+    if args.num_users is not None:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data,
+                                                   num_users=args.num_users))
+    if args.synthetic_scale is not None:
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data,
+            synthetic_train_size=max(int(cfg.data.synthetic_train_size
+                                         * args.synthetic_scale),
+                                     cfg.data.num_users * 8),
+            synthetic_test_size=max(int(cfg.data.synthetic_test_size
+                                        * args.synthetic_scale), 64),
+        ))
+
+    from dopt.config import exp_details
+
+    print(exp_details(cfg), file=sys.stderr)
+    trainer = build_trainer(cfg)
+    if args.resume:
+        trainer.restore(args.resume)
+        print(f"resumed at round {trainer.round}", file=sys.stderr)
+
+    rounds = args.rounds
+    if rounds is None:
+        rounds = (cfg.federated.rounds if cfg.federated is not None
+                  else cfg.gossip.rounds)
+    trainer.run(rounds=rounds)
+    for row in trainer.history.rows[-min(rounds, len(trainer.history)):]:
+        print(json.dumps(row))
+    print(f"total_time_s={trainer.total_time:.2f}", file=sys.stderr)
+
+    if args.timers:
+        print(trainer.timers.report(), file=sys.stderr)
+    if args.csv:
+        trainer.history.to_csv(args.csv)
+        print(f"wrote {args.csv}", file=sys.stderr)
+    if args.checkpoint:
+        trainer.save(args.checkpoint)
+        print(f"checkpointed to {args.checkpoint}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,87 @@
+"""Checkpoint / resume (absent in the reference — SURVEY §5).
+
+The reference persists only metric CSVs; model state lives and dies
+with the Colab runtime (the only continuity is ``Server.global_round``
+surviving across ``run()`` calls in memory, servers.py:18,78).  dopt
+checkpoints the full training state — stacked params, momentum buffers,
+ADMM duals, global model, round counter, and metric history — with
+orbax for the array pytrees plus a JSON sidecar for scalars/history.
+
+Layout:  <dir>/state/   orbax pytree checkpoint
+         <dir>/meta.json  {round, name, history rows}
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+
+    HAVE_ORBAX = True
+except ImportError:  # pragma: no cover
+    HAVE_ORBAX = False
+
+
+def _to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
+                    meta: dict[str, Any]) -> Path:
+    """Save an arrays pytree (orbax) + JSON metadata."""
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    arrays = {k: _to_numpy(v) for k, v in arrays.items() if v is not None}
+    if HAVE_ORBAX:
+        ckpt = ocp.PyTreeCheckpointer()
+        state_dir = path / "state"
+        if state_dir.exists():
+            import shutil
+
+            shutil.rmtree(state_dir)
+        ckpt.save(state_dir, arrays)
+    else:  # numpy fallback keeps the feature alive without orbax
+        np.savez(path / "state.npz", **_flatten_for_npz(arrays))
+    (path / "meta.json").write_text(json.dumps(meta, indent=2))
+    return path
+
+
+def load_checkpoint(path: str | Path) -> tuple[dict[str, Any], dict[str, Any]]:
+    """Returns (arrays, meta)."""
+    path = Path(path).absolute()
+    meta = json.loads((path / "meta.json").read_text())
+    if HAVE_ORBAX and (path / "state").exists():
+        ckpt = ocp.PyTreeCheckpointer()
+        arrays = ckpt.restore(path / "state")
+    else:
+        with np.load(path / "state.npz") as z:
+            arrays = _unflatten_from_npz(dict(z))
+    return arrays, meta
+
+
+def _flatten_for_npz(tree, prefix="") -> dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_for_npz(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten_from_npz(flat: dict[str, np.ndarray]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out

@@ -1,0 +1,54 @@
+"""History plotting (reference ``plot``/``servers_plot`` equivalents).
+
+Recreates the reference's comparison plots — ``Server.plot`` per-client
+grids (servers.py:95-120) and ``servers_plot`` cross-experiment curves
+(P1 utils.py:29-51, P2 utils.py:26-48) — from ``History`` objects.
+Matplotlib only; import is deferred so headless/metric-only use never
+pays for it.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Mapping, Sequence
+
+from dopt.utils.metrics import History
+
+
+def compare_histories(
+    histories: Mapping[str, History] | Sequence[tuple[str, History]],
+    *,
+    metrics: Sequence[str] = ("avg_test_acc", "avg_test_loss", "avg_train_loss"),
+    title: str = "",
+    save: str | Path | None = None,
+):
+    """Cross-experiment comparison grid (the ``servers_plot`` shape:
+    one panel per metric, one labelled curve per experiment)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    items = list(histories.items()) if isinstance(histories, Mapping) else list(histories)
+    n = len(metrics)
+    fig, axes = plt.subplots(1, n, figsize=(5 * n, 4))
+    if n == 1:
+        axes = [axes]
+    for ax, metric in zip(axes, metrics):
+        for label, h in items:
+            xs = [r["round"] for r in h if metric in r]
+            ys = [r[metric] for r in h if metric in r]
+            if xs:
+                ax.plot(xs, ys, marker="o", markersize=3, label=label)
+        ax.set_xlabel("round")
+        ax.set_ylabel(metric)
+        ax.grid(alpha=0.3)
+        ax.legend(fontsize=8)
+    if title:
+        fig.suptitle(title)
+    fig.tight_layout()
+    if save is not None:
+        fig.savefig(save, dpi=120)
+        plt.close(fig)
+        return Path(save)
+    return fig

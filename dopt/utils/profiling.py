@@ -1,0 +1,81 @@
+"""Tracing / profiling (absent in the reference — SURVEY §5).
+
+The reference's only instrumentation is ``time.time()`` around
+``run()`` printed as "Total Run Time" plus tqdm bars (servers.py:51,79;
+simulators.py:115-137).  dopt provides:
+
+* ``PhaseTimers`` — named wall-clock accumulators for the round phases
+  (consensus vs local step vs eval vs host batch-planning); rounds/sec
+  is a north-star metric so phase attribution is first-class.
+* ``trace()`` — context manager wrapping ``jax.profiler`` to dump an
+  XLA trace viewable in TensorBoard/Perfetto.
+
+Note on async dispatch: jax returns before device work finishes, so a
+``phase()`` context around a jit call measures dispatch only.  Use
+``measure(name, fn, *args)`` to attribute device time — it blocks on
+the function's result via ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Any, Iterator
+
+import jax
+
+
+class PhaseTimers:
+    """Accumulates wall-clock per named phase."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Host wall-clock for the block (dispatch-only for jit calls —
+        use ``measure`` to include device time)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def measure(self, name: str, fn, *args, **kwargs):
+        """Run fn, block on its result, attribute the time to ``name``."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        self.totals[name] += time.perf_counter() - t0
+        self.counts[name] += 1
+        return out
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            name: {
+                "total_s": round(self.totals[name], 4),
+                "count": self.counts[name],
+                "mean_s": round(self.totals[name] / max(self.counts[name], 1), 5),
+            }
+            for name in self.totals
+        }
+
+    def report(self) -> str:
+        rows = ["phase                total_s   count   mean_s"]
+        for name, s in sorted(self.summary().items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            rows.append(f"{name:20s} {s['total_s']:8.3f} {s['count']:7d} {s['mean_s']:9.5f}")
+        return "\n".join(rows)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """XLA profiler trace (TensorBoard/Perfetto-viewable)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,52 @@
+"""Presets: all construct valid configs; a sample runs end-to-end via CLI."""
+
+import pytest
+
+from dopt.presets import PRESETS, get_preset
+
+
+def test_all_presets_construct():
+    for name in PRESETS:
+        cfg = get_preset(name)
+        assert (cfg.federated is None) != (cfg.gossip is None), name
+
+
+def test_unknown_preset():
+    with pytest.raises(ValueError, match="unknown preset"):
+        get_preset("nope")
+
+
+def test_reference_grid_params():
+    # P1 notebook cells 8/10 parameters.
+    cfg = get_preset("reference-fedavg")
+    assert cfg.data.num_users == 100 and cfg.seed == 2022
+    assert cfg.federated.frac == 0.1 and cfg.federated.local_ep == 10
+    assert cfg.optim.lr == 0.1 and cfg.model.faithful
+    # P2 notebook cell 11 parameters.
+    cfg = get_preset("reference-dsgd-circle")
+    assert cfg.data.num_users == 6 and cfg.seed == 2028
+    assert cfg.gossip.local_bs == 128 and not cfg.data.iid
+
+
+def test_cli_end_to_end(devices, tmp_path, capsys):
+    from dopt.run import main
+    rc = main(["--preset", "baseline1", "--rounds", "2",
+               "--synthetic-scale", "0.01",
+               "--csv", str(tmp_path / "h.csv"),
+               "--checkpoint", str(tmp_path / "ck")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"round": 1' in out
+    assert (tmp_path / "h.csv").exists()
+    assert (tmp_path / "ck" / "meta.json").exists()
+
+
+def test_cli_resume(devices, tmp_path, capsys):
+    from dopt.run import main
+    main(["--preset", "baseline1", "--rounds", "1", "--synthetic-scale", "0.01",
+          "--checkpoint", str(tmp_path / "ck")])
+    rc = main(["--preset", "baseline1", "--rounds", "1",
+               "--synthetic-scale", "0.01", "--resume", str(tmp_path / "ck")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"round": 1' in out  # continued from round 1
