@@ -31,6 +31,9 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="tiny data / few rounds (CI smoke, not a benchmark)")
     ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--block", type=int, default=None,
+                    help="rounds fused per jit dispatch (default: all "
+                         "measured rounds in one fused lax.scan block)")
     args = ap.parse_args()
 
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
@@ -54,12 +57,18 @@ def main() -> None:
                             local_bs=128),
     )
     trainer = GossipTrainer(cfg)
+    block = args.block or measure_rounds
 
-    # Warmup: compile + first round.
-    trainer.run(rounds=1)
+    # Warmup: compile the fused block step for every block size the
+    # measured loop will dispatch (the remainder block retraces).
+    trainer.run(rounds=block, block=block)
+    if measure_rounds % block:
+        # block > remainder keeps this on the blocked path (k=remainder),
+        # compiling the same trace the measured loop's last dispatch uses.
+        trainer.run(rounds=measure_rounds % block, block=block)
 
     t0 = time.time()
-    trainer.run(rounds=measure_rounds)
+    trainer.run(rounds=measure_rounds, block=block)
     elapsed = time.time() - t0
     rounds_per_sec = measure_rounds / elapsed
 

@@ -30,6 +30,9 @@ class DataConfig:
     synthetic_train_size: int = 2048
     synthetic_test_size: int = 512
     unequal: bool = False
+    plan_impl: str = "numpy"  # "native" = C++ host runtime (dopt.native)
+    # for per-round batch-plan generation; numpy remains the
+    # torch-oracle-parity mode
 
 
 @dataclass(frozen=True)
@@ -56,6 +59,8 @@ class OptimizerConfig:
     momentum: float = 0.5
     weight_decay: float = 0.0
     rho: float = 0.1   # FedProx proximal weight / FedADMM penalty
+    fused_update: bool = False  # pallas single-pass momentum-SGD update
+    # (dopt.ops.fused_update); numerics identical to the jnp path
 
 
 @dataclass(frozen=True)
@@ -80,6 +85,9 @@ class GossipConfig:
     local_ep: int = 4
     local_bs: int = 128
     eps: int = 1                # consensus sweeps per round (FedLCon)
+    block_rounds: int = 1       # rounds fused into ONE jit (lax.scan) per
+    # dispatch; >1 removes per-round host sync + dispatch overhead (the
+    # fast path for throughput; eval happens at block boundaries only)
     faithful_bugs: bool = False
     # faithful_bugs=True replicates documented reference bugs (FedLCon's
     # stale new_weights accumulation, simulators.py:189-196) for oracle

@@ -52,6 +52,7 @@ def make_batch_plan(
     seed: int = 0,
     round_idx: int = 0,
     drop_last: bool = False,
+    impl: str = "numpy",
 ) -> BatchPlan:
     """Build the shuffled batch plan for one round.
 
@@ -60,7 +61,22 @@ def make_batch_plan(
     (seed, round_idx, epoch, worker) so the torch oracle and the jax
     engine consume byte-identical batches — that determinism is what
     makes step-level numerics parity testable at all.
+
+    ``impl='native'`` fills the plan with the C++ host runtime
+    (``dopt.native``) — same contract and determinism key, different
+    (xoshiro) RNG stream, so it is the throughput mode, not the
+    oracle-parity mode; silently falls back to numpy when the native
+    library is unavailable.
     """
+    if impl == "native":
+        from dopt.native import fill_batch_plan_native
+
+        out = fill_batch_plan_native(
+            index_matrix, batch_size=batch_size, local_ep=local_ep,
+            seed=seed, round_idx=round_idx, drop_last=drop_last,
+        )
+        if out is not None:
+            return BatchPlan(idx=out[0], weight=out[1])
     w, l = index_matrix.shape
     bs = min(batch_size, l)
     if drop_last:

@@ -103,7 +103,7 @@ class FederatedTrainer:
 
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
-            faithful=cfg.model.faithful,
+            faithful=cfg.model.faithful, dtype=cfg.model.compute_dtype,
         )
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))
@@ -124,6 +124,7 @@ class FederatedTrainer:
             algorithm={"fedavg": "sgd", "fedprox": "fedprox",
                        "fedadmm": "fedadmm"}[f.algorithm],
             rho=cfg.optim.rho,
+            update_impl="pallas" if cfg.optim.fused_update else "jnp",
         )
         global_eval = make_evaluator(self.model.apply)
         algorithm = f.algorithm
@@ -195,7 +196,7 @@ class FederatedTrainer:
                 mask = self.sample_clients(frac)
                 plan = make_batch_plan(
                     self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
-                    seed=cfg.seed, round_idx=t,
+                    seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                 )
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)

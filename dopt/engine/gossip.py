@@ -32,10 +32,12 @@ import numpy as np
 
 from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
-from dopt.engine.local import make_stacked_evaluator, make_stacked_local_update
+from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update,
+                               make_stacked_local_update_gather)
 from dopt.models import build_model, count_params
 from dopt.parallel.collectives import broadcast_to_workers, mix_power
-from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.mesh import (WORKER_AXIS, fit_mesh_devices, make_mesh,
+                                shard_worker_tree, worker_sharding)
 from dopt.topology import MixingMatrices, build_mixing_matrices
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
@@ -120,7 +122,7 @@ class GossipTrainer:
         # the reference deepcopies one global model, simulators.py:23-24).
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
-            faithful=cfg.model.faithful,
+            faithful=cfg.model.faithful, dtype=cfg.model.compute_dtype,
         )
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))
@@ -143,14 +145,19 @@ class GossipTrainer:
         self._matching_rng = host_rng(cfg.seed, 60551)
 
         # Compiled round step.
+        update_impl = "pallas" if cfg.optim.fused_update else "jnp"
         local = make_stacked_local_update(
             self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
-            algorithm="sgd",
+            algorithm="sgd", update_impl=update_impl,
         )
         evaluator = make_stacked_evaluator(self.model.apply)
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
         mesh = self.mesh
+
+        def zeros_eval():
+            z = jnp.zeros(self.num_workers)
+            return {"acc": z, "loss_sum": z, "loss_mean": z, "count": z}
 
         def round_fn(params, mom, w_matrix, idx, bweight, train_x, train_y,
                      ex, ey, ew, do_eval):
@@ -159,12 +166,7 @@ class GossipTrainer:
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
-                lambda: {
-                    "acc": jnp.zeros(self.num_workers),
-                    "loss_sum": jnp.zeros(self.num_workers),
-                    "loss_mean": jnp.zeros(self.num_workers),
-                    "count": jnp.zeros(self.num_workers),
-                },
+                zeros_eval,
             )
             bx = train_x[idx]
             by = train_y[idx]
@@ -173,6 +175,93 @@ class GossipTrainer:
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
         self._sharding = worker_sharding(self.mesh)
+
+        # Fused multi-round block path (lax.scan over rounds in ONE jit).
+        self._evaluator = evaluator
+        self._do_mix, self._eps = do_mix, eps
+        self._local_gather = make_stacked_local_update_gather(
+            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            algorithm="sgd", update_impl=update_impl,
+        )
+        local_g, ev = self._local_gather, self._evaluator
+
+        def block_fn(params, mom, w_mats, idx, bw, is_eval, train_x, train_y,
+                     ex, ey, ew):
+            """k rounds fused into one lax.scan dispatch (jit retraces per
+            distinct k).  Each iteration is one full reference round with
+            the SAME phase order as the per-round path — consensus →
+            eval (on flagged rounds only) → local epochs — so history
+            rows are directly comparable across block settings.  The
+            minibatch gather happens inside the step scan from the
+            resident train arrays; compile cost is O(1) in k."""
+
+            def body(carry, xs):
+                p, m = carry
+                w_t, idx_t, bw_t, ev_t = xs
+                if do_mix:
+                    p = mix_power(p, w_t, eps=eps, mesh=mesh)
+                evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
+                p, m, losses, accs = local_g(p, m, idx_t, bw_t, train_x, train_y)
+                return (p, m), (losses.mean(), accs.mean(), evalm)
+
+            (params, mom), (tl, ta, evalms) = jax.lax.scan(
+                body, (params, mom), (w_mats, idx, bw, is_eval)
+            )
+            return params, mom, tl, ta, evalms
+
+        self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1))
+
+    def _run_blocked(self, rounds: int, block: int) -> History:
+        """Run ``rounds`` rounds in fused blocks of up to ``block``."""
+        cfg, g = self.cfg, self.cfg.gossip
+        block_sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(None, WORKER_AXIS)
+        )
+        t0 = time.time()
+        done = 0
+        while done < rounds:
+            k = min(block, rounds - done)
+            ts = [self.round + j for j in range(k)]
+            with self.timers.phase("host_batch_plan"):
+                w_mats = np.stack(
+                    [self._matrix_for_round(t) for t in ts]
+                ).astype(np.float32)
+                plans = [
+                    make_batch_plan(self.index_matrix, batch_size=g.local_bs,
+                                    local_ep=g.local_ep, seed=cfg.seed,
+                                    round_idx=t, impl=cfg.data.plan_impl)
+                    for t in ts
+                ]
+                idx = jax.device_put(np.stack([p.idx for p in plans]),
+                                     block_sharding)
+                bw = jax.device_put(np.stack([p.weight for p in plans]),
+                                    block_sharding)
+            is_eval = np.asarray(
+                [(t % self.eval_every) == 0 for t in ts], dtype=bool
+            )
+            self.params, self.momentum, tl, ta, evalms = self.timers.measure(
+                "round_step", self._block_fn,
+                self.params, self.momentum, w_mats, idx, bw,
+                jnp.asarray(is_eval), self._train_x, self._train_y,
+                *self._eval,
+            )
+            tl, ta = np.asarray(tl), np.asarray(ta)
+            acc = np.asarray(evalms["acc"])
+            loss_mean = np.asarray(evalms["loss_mean"])
+            for j, t in enumerate(ts):
+                row = {
+                    "round": t,
+                    "avg_train_loss": float(tl[j]),
+                    "avg_train_acc": float(ta[j]),
+                }
+                if is_eval[j]:
+                    row["avg_test_acc"] = float(acc[j].mean())
+                    row["avg_test_loss"] = float(loss_mean[j].mean())
+                self.history.append(**row)
+                self.round += 1
+            done += k
+        self.total_time = time.time() - t0
+        return self.history
 
     # ------------------------------------------------------------------
     def _matrix_for_round(self, t: int) -> np.ndarray:
@@ -183,12 +272,21 @@ class GossipTrainer:
             return self.mixing.for_round(t)
         return np.eye(self.num_workers)
 
-    def run(self, rounds: int | None = None, eps: int | None = None) -> History:
-        """Train; mirrors ``Simulator.run(rounds)`` / ``FedLCon.run(rounds, eps)``."""
+    def run(self, rounds: int | None = None, eps: int | None = None,
+            block: int | None = None) -> History:
+        """Train; mirrors ``Simulator.run(rounds)`` / ``FedLCon.run(rounds, eps)``.
+
+        ``block`` (default ``cfg.gossip.block_rounds``) > 1 fuses that
+        many rounds into one jit dispatch (``_run_blocked``) — same
+        math, same phase order, same eval cadence; only the host/device
+        round-trip count changes."""
         cfg, g = self.cfg, self.cfg.gossip
         rounds = g.rounds if rounds is None else rounds
         if eps is not None and eps != g.eps and g.algorithm == "fedlcon":
             raise ValueError("set eps in GossipConfig (static for compilation)")
+        block = g.block_rounds if block is None else block
+        if block > 1:
+            return self._run_blocked(rounds, block)
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
@@ -196,7 +294,7 @@ class GossipTrainer:
                 w_t = self._matrix_for_round(t)
                 plan = make_batch_plan(
                     self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
-                    seed=cfg.seed, round_idx=t,
+                    seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                 )
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)

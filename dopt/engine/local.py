@@ -27,6 +27,42 @@ from dopt.models.losses import accuracy, cross_entropy, l2_regulariser
 from dopt.optim import SGDState, admm_grad_edit, prox_grad_edit, sgd_step
 
 
+def _apply_update(p, m, g, *, lr, momentum, update_impl):
+    """Dispatch the momentum-SGD update: 'jnp' (tree.map two-liner) or
+    'pallas' (fused single-pass kernel, dopt.ops.fused_update)."""
+    if update_impl == "pallas":
+        from dopt.ops import fused_sgd_momentum_tree
+
+        return fused_sgd_momentum_tree(p, m, g, lr=lr, mu=momentum)
+    p, st = sgd_step(p, SGDState(m), g, lr=lr, momentum=momentum)
+    return p, st.momentum
+
+
+def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,
+                    update_impl):
+    """One SGD step on concrete batch arrays — the shared body of both
+    local-update variants (materialised batches and on-device gather)."""
+
+    def step_core(p, m, x, y, w, theta=None, alpha=None):
+        def loss_fn(p_):
+            out = apply_fn({"params": p_}, x)
+            loss = cross_entropy(out, y, w)
+            if l2:
+                loss = loss + l2_regulariser(p_, l2)
+            return loss, out
+
+        (loss, out), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        if algorithm == "fedprox":
+            g = prox_grad_edit(g, p, theta, rho)
+        elif algorithm == "fedadmm":
+            g = admm_grad_edit(g, p, theta, alpha, rho)
+        p, m = _apply_update(p, m, g, lr=lr, momentum=momentum,
+                             update_impl=update_impl)
+        return p, m, loss, accuracy(out, y, w)
+
+    return step_core
+
+
 def make_local_update(
     apply_fn: Callable,
     *,
@@ -35,6 +71,7 @@ def make_local_update(
     algorithm: str = "sgd",
     rho: float = 0.0,
     l2: float = 0.0,
+    update_impl: str = "jnp",
 ):
     """Build the per-worker local-update function.
 
@@ -44,26 +81,16 @@ def make_local_update(
     """
     if algorithm not in ("sgd", "fedprox", "fedadmm"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
+    core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
+                           algorithm=algorithm, rho=rho, l2=l2,
+                           update_impl=update_impl)
 
     def local_update(params, mom, bx, by, bw, theta=None, alpha=None):
         def step(carry, batch):
             p, m = carry
             x, y, w = batch
-
-            def loss_fn(p_):
-                out = apply_fn({"params": p_}, x)
-                loss = cross_entropy(out, y, w)
-                if l2:
-                    loss = loss + l2_regulariser(p_, l2)
-                return loss, out
-
-            (loss, out), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
-            if algorithm == "fedprox":
-                g = prox_grad_edit(g, p, theta, rho)
-            elif algorithm == "fedadmm":
-                g = admm_grad_edit(g, p, theta, alpha, rho)
-            p, st = sgd_step(p, SGDState(m), g, lr=lr, momentum=momentum)
-            return (p, st.momentum), (loss, accuracy(out, y, w))
+            p, m, loss, acc = core(p, m, x, y, w, theta, alpha)
+            return (p, m), (loss, acc)
 
         (params, mom), (losses, accs) = jax.lax.scan(step, (params, mom), (bx, by, bw))
         return params, mom, losses, accs
@@ -72,13 +99,14 @@ def make_local_update(
 
 
 def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
-                              rho=0.0, l2=0.0):
+                              rho=0.0, l2=0.0, update_impl="jnp"):
     """vmap the per-worker update over the leading worker axis.
 
     theta (global model) is broadcast; alpha (ADMM duals) is stacked.
     """
     fn = make_local_update(apply_fn, lr=lr, momentum=momentum,
-                           algorithm=algorithm, rho=rho, l2=l2)
+                           algorithm=algorithm, rho=rho, l2=l2,
+                           update_impl=update_impl)
     if algorithm == "sgd":
         return jax.vmap(lambda p, m, bx, by, bw: fn(p, m, bx, by, bw))
     if algorithm == "fedprox":
@@ -90,6 +118,72 @@ def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
         lambda p, m, bx, by, bw, theta, alpha: fn(p, m, bx, by, bw,
                                                   theta=theta, alpha=alpha),
         in_axes=(0, 0, 0, 0, 0, None, 0),
+    )
+
+
+def make_local_update_gather(
+    apply_fn: Callable,
+    *,
+    lr: float,
+    momentum: float,
+    algorithm: str = "sgd",
+    rho: float = 0.0,
+    l2: float = 0.0,
+    update_impl: str = "jnp",
+):
+    """Like ``make_local_update`` but gathers each minibatch from the full
+    on-device dataset inside the step scan: the caller passes the [S, B]
+    index/weight plan plus the resident train arrays instead of
+    materialised [S, B, ...] batches.  Peak activation memory drops from
+    O(S·B·|x|) to O(B·|x|), which is what lets the fused multi-round
+    block path keep K rounds of plans on device at once.
+
+    Returns fn(params, mom, idx, bw, train_x, train_y, theta=None,
+    alpha=None) -> (new_params, new_mom, losses[S], accs[S]).
+    """
+    if algorithm not in ("sgd", "fedprox", "fedadmm"):
+        raise ValueError(f"unknown local algorithm {algorithm!r}")
+    core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
+                           algorithm=algorithm, rho=rho, l2=l2,
+                           update_impl=update_impl)
+
+    def local_update(params, mom, idx, bw, train_x, train_y,
+                     theta=None, alpha=None):
+        def step(carry, batch):
+            p, m = carry
+            i, w = batch
+            p, m, loss, acc = core(p, m, train_x[i], train_y[i], w, theta, alpha)
+            return (p, m), (loss, acc)
+
+        (params, mom), (losses, accs) = jax.lax.scan(step, (params, mom), (idx, bw))
+        return params, mom, losses, accs
+
+    return local_update
+
+
+def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
+                                     algorithm="sgd", rho=0.0, l2=0.0,
+                                     update_impl="jnp"):
+    """vmap the gather-variant over the leading worker axis; train arrays
+    and theta broadcast, ADMM duals stacked per worker."""
+    fn = make_local_update_gather(apply_fn, lr=lr, momentum=momentum,
+                                  algorithm=algorithm, rho=rho, l2=l2,
+                                  update_impl=update_impl)
+    if algorithm == "sgd":
+        return jax.vmap(
+            lambda p, m, idx, bw, tx, ty: fn(p, m, idx, bw, tx, ty),
+            in_axes=(0, 0, 0, 0, None, None),
+        )
+    if algorithm == "fedprox":
+        return jax.vmap(
+            lambda p, m, idx, bw, tx, ty, theta: fn(p, m, idx, bw, tx, ty,
+                                                    theta=theta),
+            in_axes=(0, 0, 0, 0, None, None, None),
+        )
+    return jax.vmap(
+        lambda p, m, idx, bw, tx, ty, theta, alpha: fn(
+            p, m, idx, bw, tx, ty, theta=theta, alpha=alpha),
+        in_axes=(0, 0, 0, 0, None, None, None, 0),
     )
 
 

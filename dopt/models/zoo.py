@@ -196,7 +196,11 @@ def build_model(
     ``faithful=None`` keeps each model's own default: True only for
     the two reference CNNs (which have a double-softmax to be faithful
     to), False for mlp/logistic/resnet18 (new models, corrected head).
+    ``dtype`` may be a string ("bfloat16" → MXU-native compute); params
+    stay float32 (flax param_dtype default) — bf16 is compute-only.
     """
+    if isinstance(dtype, str):
+        dtype = jnp.dtype(dtype)
     key = name.lower()
     if key not in _ZOO:
         raise ValueError(f"unknown model {name!r}; one of {sorted(_ZOO)}")

@@ -1,0 +1,131 @@
+"""Native (C++) host-runtime components, bound via ctypes.
+
+The reference is pure Python (SURVEY §2: no native components anywhere
+in the tree), so nothing here is owed for parity — this is the
+framework's own host runtime: per-round batch-plan generation in C++
+(``plan.cpp``) so the host side never throttles the TPU at large worker
+counts.
+
+Build model: compiled lazily with ``g++ -O3 -shared -fPIC`` into the
+package directory on first use and cached (mtime-checked against the
+source); every entry point degrades gracefully to the numpy
+implementation when no compiler or binary is available, so the native
+layer is a pure accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "plan.cpp")
+_ABI_VERSION = 1
+# ABI version in the filename: a cached .so from a different source
+# generation gets a different name, so a rebuild can never collide with
+# an already-dlopened stale handle (glibc returns the existing handle
+# for a known pathname).
+_LIB = os.path.join(_DIR, f"libdopt_host_v{_ABI_VERSION}.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile plan.cpp → libdopt_host.so. Returns success."""
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        fresh = os.path.exists(_LIB) and (
+            not os.path.exists(_SRC)
+            or os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        )
+        if not fresh and not _build():
+            return None
+        try:
+            # Single dlopen, then validate; never re-dlopen the same
+            # pathname in-process (it would return the stale handle).
+            lib = ctypes.CDLL(_LIB)
+            lib.dopt_native_abi_version.restype = ctypes.c_int
+            if lib.dopt_native_abi_version() != _ABI_VERSION:
+                return None  # pathological stale build → numpy fallback
+            lib.dopt_fill_batch_plan.restype = ctypes.c_int
+            lib.dopt_fill_batch_plan.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),  # index_matrix
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # W, L, B
+                ctypes.c_int64, ctypes.c_int64,  # local_ep, steps_per_epoch
+                ctypes.c_int32,                  # drop_last
+                ctypes.c_int64, ctypes.c_int64,  # seed, round_idx
+                ctypes.POINTER(ctypes.c_int32),  # idx_out
+                ctypes.POINTER(ctypes.c_float),  # w_out
+            ]
+            _lib = lib
+        except (OSError, AttributeError):
+            # unloadable binary / missing symbol → graceful numpy fallback
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def fill_batch_plan_native(
+    index_matrix: np.ndarray,
+    *,
+    batch_size: int,
+    local_ep: int,
+    seed: int,
+    round_idx: int,
+    drop_last: bool = False,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Native batch-plan fill; returns (idx, weight) arrays shaped like
+    ``dopt.data.pipeline.make_batch_plan``'s, or None when the native
+    library is unavailable (caller falls back to numpy).
+
+    Deterministic in (seed, round_idx, epoch, worker) via a seeded
+    xoshiro256** stream — NOT bit-identical to the numpy PCG64 plans
+    (use the numpy path for torch-oracle parity runs).
+    """
+    lib = load_native()
+    if lib is None:
+        return None
+    im = np.ascontiguousarray(index_matrix, dtype=np.int32)
+    w, l = im.shape
+    bs = min(batch_size, l)
+    steps_per_epoch = (l // bs) if drop_last else -(-l // bs)
+    s = local_ep * steps_per_epoch
+    idx = np.empty((w, s, bs), dtype=np.int32)
+    weight = np.empty((w, s, bs), dtype=np.float32)
+    rc = lib.dopt_fill_batch_plan(
+        im.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        w, l, bs, local_ep, steps_per_epoch, int(drop_last),
+        seed, round_idx,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        weight.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        return None
+    return idx, weight
+
+
+__all__ = ["load_native", "native_available", "fill_batch_plan_native"]

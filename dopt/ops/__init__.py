@@ -1,0 +1,11 @@
+from dopt.ops.fused_update import (
+    fused_sgd_momentum,
+    fused_sgd_momentum_tree,
+    pallas_available,
+)
+
+__all__ = [
+    "fused_sgd_momentum",
+    "fused_sgd_momentum_tree",
+    "pallas_available",
+]
