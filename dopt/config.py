@@ -67,7 +67,7 @@ class OptimizerConfig:
 class FederatedConfig:
     """Server-coordinated path (reference P1 ``servers.py``)."""
 
-    algorithm: str = "fedavg"   # fedavg | fedprox | fedadmm
+    algorithm: str = "fedavg"   # fedavg | fedprox | fedadmm | scaffold
     frac: float = 0.1           # fraction of users sampled per round
     rounds: int = 20
     local_ep: int = 10

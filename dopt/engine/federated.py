@@ -38,7 +38,7 @@ from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import make_evaluator, make_stacked_local_update
 from dopt.models import build_model, count_params
-from dopt.optim import admm_dual_ascent
+from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import broadcast_to_workers, masked_average
 from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
@@ -55,13 +55,22 @@ def _where_mask(mask, a, b):
 
 
 class FederatedTrainer:
-    """FedAvg / FedProx / FedADMM with partial participation."""
+    """FedAvg / FedProx / FedADMM / SCAFFOLD with partial participation.
+
+    SCAFFOLD exists in the reference only as commented-out dead code
+    (``Decentralized Optimization/src/clients.py:146-170``); here it is
+    the real algorithm: client control variates c_i are a worker-stacked
+    sharded pytree (like the ADMM duals), the server control variate c is
+    replicated, the local gradient edit is ``g − c_i + c`` and the
+    option-II refresh ``c_i⁺ = c_i − c + (theta − y_i)/(K·lr)`` runs after
+    the local epochs for sampled workers only.
+    """
 
     def __init__(self, cfg: ExperimentConfig, *, eval_train: bool = True):
         if cfg.federated is None:
             raise ValueError("cfg.federated must be set for FederatedTrainer")
         f = cfg.federated
-        if f.algorithm not in ("fedavg", "fedprox", "fedadmm"):
+        if f.algorithm not in ("fedavg", "fedprox", "fedadmm", "scaffold"):
             raise ValueError(f"unknown federated algorithm {f.algorithm!r}")
         self.cfg = cfg
         self.eval_train = eval_train
@@ -114,35 +123,68 @@ class FederatedTrainer:
         self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
             jax.tree.map(np.zeros_like, stacked), self.mesh)
+        # Worker-stacked companion state: ADMM duals (clients.py:120-123)
+        # or SCAFFOLD client control variates c_i; both live sharded over
+        # the worker axis.  SCAFFOLD additionally keeps the replicated
+        # server control variate c.
         self.duals = (
             shard_worker_tree(jax.tree.map(np.zeros_like, stacked), self.mesh)
-            if f.algorithm == "fedadmm" else None
+            if f.algorithm in ("fedadmm", "scaffold") else None
+        )
+        self.c_global = (
+            jax.tree.map(np.zeros_like, self.theta)
+            if f.algorithm == "scaffold" else None
         )
 
         local = make_stacked_local_update(
             self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm={"fedavg": "sgd", "fedprox": "fedprox",
-                       "fedadmm": "fedadmm"}[f.algorithm],
+                       "fedadmm": "fedadmm", "scaffold": "scaffold"}[f.algorithm],
             rho=cfg.optim.rho,
             update_impl="pallas" if cfg.optim.fused_update else "jnp",
         )
         global_eval = make_evaluator(self.model.apply)
         algorithm = f.algorithm
         rho = cfg.optim.rho
+        lr = cfg.optim.lr
+        momentum_coef = cfg.optim.momentum
         eval_train_flag = eval_train
 
-        def round_fn(theta, params, mom, duals, mask, idx, bweight,
+        def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
                      train_x, train_y, ex, ey, ew, tidx, tweight):
             bx = train_x[idx]
             by = train_y[idx]
             theta_b = broadcast_to_workers(theta, w)
             start = _where_mask(mask, theta_b, params)
+            new_c = c_global
             if algorithm == "fedavg":
                 p_t, m_t, losses, accs = local(start, mom, bx, by, bweight)
                 new_duals = duals
             elif algorithm == "fedprox":
                 p_t, m_t, losses, accs = local(start, mom, bx, by, bweight, theta)
                 new_duals = duals
+            elif algorithm == "scaffold":
+                # Sampled workers restart from theta with a FRESH momentum
+                # buffer so theta − y_i reflects only this round's
+                # gradients (no stale-round momentum in the control
+                # refresh); effective step size lr/(1−μ) accounts for
+                # heavy-ball amplification of the displacement.
+                mom0 = jax.tree.map(jnp.zeros_like, mom)
+                p_t, m_t, losses, accs = local(start, mom0, bx, by, bweight,
+                                               c_global, duals)
+                steps = bweight.shape[1]
+                lr_eff = lr / max(1.0 - momentum_coef, 1e-8)
+                refreshed = jax.vmap(
+                    lambda ci, y: scaffold_control_update(
+                        ci, c_global, theta, y, lr=lr_eff, num_steps=steps),
+                    in_axes=(0, 0),
+                )(duals, p_t)
+                new_duals = _where_mask(mask, refreshed, duals)
+                # c ← c + (1/N)·Σ_{i∈S}(c_i⁺ − c_i); unsampled deltas are 0.
+                new_c = jax.tree.map(
+                    lambda c, dn, do: c + (dn - do).sum(axis=0) / w,
+                    c_global, new_duals, duals,
+                )
             else:
                 p_t, m_t, losses, accs = local(start, mom, bx, by, bweight,
                                                theta, duals)
@@ -163,7 +205,8 @@ class FederatedTrainer:
                 trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
                           "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
-            return new_theta, new_p, new_m, new_duals, local_loss, evalm, trainm
+            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
+                    evalm, trainm)
 
         # Per-worker train-split eval: every input has a worker axis.
         stacked_eval_perworker = jax.vmap(
@@ -201,16 +244,19 @@ class FederatedTrainer:
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             duals_in = self.duals if self.duals is not None else {}
-            (self.theta, self.params, self.momentum, new_duals,
+            c_in = self.c_global if self.c_global is not None else {}
+            (self.theta, self.params, self.momentum, new_duals, new_c,
              local_loss, evalm, trainm) = self.timers.measure(
                 "round_step", self._round_fn,
-                self.theta, self.params, self.momentum, duals_in,
+                self.theta, self.params, self.momentum, duals_in, c_in,
                 jnp.asarray(mask), idx, bweight,
                 self._train_x, self._train_y, *self._eval,
                 self._train_eval_idx, self._train_eval_w,
             )
             if self.duals is not None:
                 self.duals = new_duals
+            if self.c_global is not None:
+                self.c_global = new_c
             self.history.append(
                 round=t,
                 test_acc=float(evalm["acc"]),
@@ -234,6 +280,8 @@ class FederatedTrainer:
                   "momentum": self.momentum}
         if self.duals is not None:
             arrays["duals"] = self.duals
+        if self.c_global is not None:
+            arrays["c_global"] = self.c_global
         save_checkpoint(
             path, arrays=arrays,
             meta={"round": self.round, "name": self.cfg.name,
@@ -252,12 +300,21 @@ class FederatedTrainer:
                 f"trainer runs {self.cfg.federated.algorithm!r}"
             )
         if self.duals is not None and "duals" not in arrays:
-            raise ValueError("fedadmm trainer requires duals in the checkpoint")
+            raise ValueError(
+                f"{self.cfg.federated.algorithm} trainer requires its "
+                "worker-stacked companion state ('duals') in the checkpoint"
+            )
         self.theta = arrays["theta"]
         self.params = shard_worker_tree(arrays["params"], self.mesh)
         self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
         if "duals" in arrays and self.duals is not None:
             self.duals = shard_worker_tree(arrays["duals"], self.mesh)
+        if self.c_global is not None:
+            if "c_global" not in arrays:
+                raise ValueError(
+                    "scaffold trainer requires the server control variate "
+                    "('c_global') in the checkpoint")
+            self.c_global = arrays["c_global"]
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
         if meta.get("sample_rng_state"):

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from dopt.models.losses import accuracy, cross_entropy, l2_regulariser
-from dopt.optim import SGDState, admm_grad_edit, prox_grad_edit, sgd_step
+from dopt.optim import (SGDState, admm_grad_edit, prox_grad_edit,
+                        scaffold_grad_edit, sgd_step)
 
 
 def _apply_update(p, m, g, *, lr, momentum, update_impl):
@@ -56,6 +57,10 @@ def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,
             g = prox_grad_edit(g, p, theta, rho)
         elif algorithm == "fedadmm":
             g = admm_grad_edit(g, p, theta, alpha, rho)
+        elif algorithm == "scaffold":
+            # theta slot carries the server control variate c (broadcast),
+            # alpha slot the client control variate c_i (worker-stacked).
+            g = scaffold_grad_edit(g, theta, alpha)
         p, m = _apply_update(p, m, g, lr=lr, momentum=momentum,
                              update_impl=update_impl)
         return p, m, loss, accuracy(out, y, w)
@@ -75,11 +80,12 @@ def make_local_update(
 ):
     """Build the per-worker local-update function.
 
-    algorithm: 'sgd' (FedAvg / D-SGD local step), 'fedprox', 'fedadmm'.
+    algorithm: 'sgd' (FedAvg / D-SGD local step), 'fedprox', 'fedadmm',
+    'scaffold' (theta slot = server control c, alpha slot = client c_i).
     Returns fn(params, mom, bx, by, bw, theta=None, alpha=None) ->
     (new_params, new_mom, losses[S], accs[S]).
     """
-    if algorithm not in ("sgd", "fedprox", "fedadmm"):
+    if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
@@ -141,7 +147,7 @@ def make_local_update_gather(
     Returns fn(params, mom, idx, bw, train_x, train_y, theta=None,
     alpha=None) -> (new_params, new_mom, losses[S], accs[S]).
     """
-    if algorithm not in ("sgd", "fedprox", "fedadmm"):
+    if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,

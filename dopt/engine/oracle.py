@@ -174,6 +174,11 @@ class OracleWorker:
         if algorithm == "fedadmm":
             self.alpha = {n: torch.zeros_like(p)
                           for n, p in model.named_parameters()}
+        if algorithm == "scaffold":
+            # Client control variate c_i (SCAFFOLD; the reference's
+            # commented-out sketch, clients.py:146-170, done properly).
+            self.control = {n: torch.zeros_like(p)
+                            for n, p in model.named_parameters()}
 
     def load(self, state: Mapping[str, "torch.Tensor"]) -> None:
         self.model.load_state_dict({k: v.clone() for k, v in state.items()})
@@ -182,7 +187,8 @@ class OracleWorker:
         return {k: v.clone() for k, v in self.model.state_dict().items()}
 
     def local_update(self, bx: np.ndarray, by: np.ndarray, bw: np.ndarray,
-                     theta: Mapping | None = None) -> float:
+                     theta: Mapping | None = None,
+                     c_global: Mapping | None = None) -> float:
         """Run the batch-plan steps: bx [S,B,C,H,W] (NCHW), by [S,B],
         bw [S,B] padding weights.  Returns mean loss."""
         losses = []
@@ -205,6 +211,11 @@ class OracleWorker:
                     if self.algorithm == "fedadmm":
                         extra = extra + self.alpha[n]
                     p.grad = p.grad + extra
+            elif self.algorithm == "scaffold":
+                for n, p in self.model.named_parameters():
+                    if p.grad is None:
+                        continue
+                    p.grad = p.grad - self.control[n] + c_global[n]
             self.optimizer.step()
             losses.append(float(loss.detach()))
         return float(np.mean(losses))
@@ -214,6 +225,20 @@ class OracleWorker:
         with torch.no_grad():
             for n, p in self.model.named_parameters():
                 self.alpha[n] = self.alpha[n] + self.rho * (p - theta[n])
+
+    def update_controls(self, theta: Mapping, c_global: Mapping,
+                        lr: float, num_steps: int) -> dict:
+        """SCAFFOLD option-II refresh c_i⁺ = c_i − c + (theta − y)/(K·lr);
+        returns the delta c_i⁺ − c_i the server accumulates into c."""
+        scale = 1.0 / (lr * max(num_steps, 1))
+        delta = {}
+        with torch.no_grad():
+            for n, p in self.model.named_parameters():
+                new = (self.control[n] - c_global[n]
+                       + scale * (theta[n] - p.detach()))
+                delta[n] = new - self.control[n]
+                self.control[n] = new
+        return delta
 
 
 def consensus(neighbor_states: list[tuple[float, Mapping]]) -> dict:

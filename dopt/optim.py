@@ -60,3 +60,39 @@ def admm_grad_edit(grads, params, theta, alpha, rho: float):
 def admm_dual_ascent(alpha, params, theta, rho: float):
     """After local epochs: alpha + rho*(p - theta)  (reference clients.py:141-144)."""
     return jax.tree.map(lambda a, p, t: a + rho * (p - t), alpha, params, theta)
+
+
+def scaffold_grad_edit(grads, c_global, c_local):
+    """SCAFFOLD variance-reduced step: g − c_i + c.
+
+    The reference sketches SCAFFOLD as commented-out dead code
+    (``Decentralized Optimization/src/clients.py:146-170``); this is the
+    standard algorithm (Karimireddy et al. 2020) implemented properly:
+    the client drifts toward the server optimum by correcting its local
+    gradient with the difference of server (c) and client (c_i) control
+    variates.
+    """
+    return jax.tree.map(
+        lambda g, c, ci: g - ci + c, grads, c_global, c_local
+    )
+
+
+def scaffold_control_update(c_local, c_global, theta, params, *,
+                            lr: float, num_steps: int):
+    """Option-II client control-variate refresh after K local steps:
+
+        c_i⁺ = c_i − c + (theta − y_i) / (K·lr)
+
+    where theta is the server model the client started from and y_i its
+    params after the K local steps.  ``lr`` must be the EFFECTIVE step
+    size of the local optimizer: for plain SGD that is the learning rate;
+    for heavy-ball momentum the displacement after K steps is
+    ≈ (lr/(1−μ))·Σg, so the caller passes lr/(1−momentum) (the engine
+    does this, and starts sampled workers from a zero momentum buffer so
+    no stale-round momentum leaks into theta − y_i).
+    """
+    scale = 1.0 / (lr * max(num_steps, 1))
+    return jax.tree.map(
+        lambda ci, c, t, y: ci - c + scale * (t - y),
+        c_local, c_global, theta, params,
+    )

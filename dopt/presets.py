@@ -142,6 +142,9 @@ PRESETS = {
     "reference-fedavg": lambda: reference_federated("fedavg"),
     "reference-fedprox": lambda: reference_federated("fedprox"),
     "reference-fedadmm": lambda: reference_federated("fedadmm"),
+    # SCAFFOLD on the P1 setup — the reference sketches it as dead code
+    # (clients.py:146-170); dopt implements the real algorithm.
+    "reference-scaffold": lambda: reference_federated("scaffold"),
     "reference-centralized": lambda: reference_gossip("centralized"),
     "reference-nocons-iid": lambda: reference_gossip("nocons", iid=True),
     "reference-nocons-noniid": lambda: reference_gossip("nocons"),

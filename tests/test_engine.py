@@ -115,11 +115,44 @@ def test_workers_fold_onto_devices(devices):
     assert len(h) == 2
 
 
-@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox", "fedadmm"])
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox", "fedadmm",
+                                       "scaffold"])
 def test_federated_learns(devices, algorithm):
     tr = FederatedTrainer(_fed_cfg(algorithm))
     h = tr.run(rounds=4)
     assert h["test_acc"][-1] > 0.6, h["test_acc"]
+
+
+def test_scaffold_first_round_matches_fedavg(devices):
+    # With zero-initialised control variates the SCAFFOLD gradient edit
+    # is exactly zero, so round 1 must be bit-compatible with FedAvg
+    # (same seed → same client sample, same batch plan).
+    import jax
+    a = FederatedTrainer(_fed_cfg("fedavg"))
+    b = FederatedTrainer(_fed_cfg("scaffold"))
+    a.run(rounds=1)
+    b.run(rounds=1)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.theta)),
+                    jax.tree.leaves(jax.device_get(b.theta))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+def test_scaffold_controls_mean_is_server_control(devices):
+    # frac=1, zero init: after round 1, c = mean_i c_i⁺ exactly.
+    import jax
+    cfg = _fed_cfg("scaffold")
+    cfg = dataclasses.replace(
+        cfg, federated=dataclasses.replace(cfg.federated, frac=1.0))
+    tr = FederatedTrainer(cfg)
+    tr.run(rounds=1)
+    ci = jax.device_get(tr.duals)
+    c = jax.device_get(tr.c_global)
+    for a, b in zip(jax.tree.leaves(ci), jax.tree.leaves(c)):
+        np.testing.assert_allclose(np.asarray(a).mean(axis=0), np.asarray(b),
+                                   atol=1e-5)
+    # and the controls actually moved
+    assert any(float(np.abs(np.asarray(l)).max()) > 0
+               for l in jax.tree.leaves(c))
 
 
 def test_federated_partial_participation_mask(devices):

@@ -125,6 +125,63 @@ def test_admm_dual_ascent_parity():
                                    err_msg=ka)
 
 
+def test_scaffold_local_update_and_control_refresh_parity():
+    """SCAFFOLD with NONZERO control variates: the jax gradient edit
+    g − c_i + c and the option-II refresh must match the torch oracle."""
+    from dopt.optim import scaffold_control_update
+
+    lr, momentum, local_ep = 0.05, 0.5, 2
+    model, params, tmodel = _setup_model1(seed=4)
+    ds = make_synthetic(seed=4, train_size=64, test_size=8)
+    plan = make_batch_plan(np.arange(64)[None, :], batch_size=16,
+                           local_ep=local_ep, seed=4)
+    bx, by, bw = gather_batches(ds.train_x, ds.train_y, plan)
+    bx, by, bw = bx[0], by[0], bw[0]
+    steps = bx.shape[0]
+
+    rng = np.random.default_rng(17)
+    c_g = jax.tree.map(
+        lambda x: jnp.asarray(rng.normal(0, 0.01, x.shape), jnp.float32),
+        params)
+    c_i = jax.tree.map(
+        lambda x: jnp.asarray(rng.normal(0, 0.01, x.shape), jnp.float32),
+        params)
+
+    # --- jax side
+    local = make_local_update(model.apply, lr=lr, momentum=momentum,
+                              algorithm="scaffold")
+    mom0 = jax.tree.map(jnp.zeros_like, params)
+    p_j, _, _, _ = jax.jit(
+        lambda p, m, a, b, c, t, al: local(p, m, a, b, c, theta=t, alpha=al)
+    )(params, mom0, bx, by, bw, c_g, c_i)
+    ci_new_j = scaffold_control_update(c_i, c_g, params, p_j, lr=lr,
+                                       num_steps=steps)
+
+    # --- torch side (same controls, converted through the param mapper)
+    worker = OracleWorker(tmodel, lr=lr, momentum=momentum,
+                          algorithm="scaffold")
+    worker.control = {k: v.clone() for k, v in
+                      flax_cnn_params_to_torch(c_i, 28).items()}
+    cg_t = flax_cnn_params_to_torch(c_g, 28)
+    theta_t = flax_cnn_params_to_torch(params, 28)
+    worker.local_update(nhwc_to_nchw(bx), by, bw, c_global=cg_t)
+    worker.update_controls(theta_t, cg_t, lr, steps)
+
+    p_t = torch_cnn_params_to_flax(worker.model.state_dict(), 28)
+    for (ka, a), (kb, b) in zip(sorted(_flat(p_j).items()),
+                                sorted(_flat(p_t).items()), strict=True):
+        assert ka == kb
+        np.testing.assert_allclose(np.asarray(a), b, atol=5e-5, rtol=1e-4,
+                                   err_msg=f"scaffold params: {ka}")
+    ci_t = torch_cnn_params_to_flax(
+        {k: v for k, v in worker.control.items()}, 28)
+    for (ka, a), (kb, b) in zip(sorted(_flat(ci_new_j).items()),
+                                sorted(_flat(ci_t).items()), strict=True):
+        assert ka == kb
+        np.testing.assert_allclose(np.asarray(a), b, atol=5e-4, rtol=1e-3,
+                                   err_msg=f"scaffold control: {ka}")
+
+
 def test_consensus_parity():
     # Weighted state-dict sum vs mix_dense on the stacked pytree.
     from dopt.parallel.collectives import mix_dense
