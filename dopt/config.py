@@ -93,6 +93,12 @@ class GossipConfig:
     # stale new_weights accumulation, simulators.py:189-196) for oracle
     # comparison; the idiomatic path fixes them.
     self_weight: bool = False   # reference mixing has zero diagonal (SURVEY §6.2)
+    dropout: float = 0.0
+    # Fault injection: per-round probability each worker is down.  Down
+    # workers skip consensus AND local training for the round; the mixing
+    # matrix is repaired (edges removed, rows renormalised —
+    # dopt.topology.repair_for_dropout) and they rejoin with stale
+    # params.  The reference has no failure handling at all (SURVEY §5).
 
 
 @dataclass(frozen=True)

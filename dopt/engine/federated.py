@@ -39,19 +39,12 @@ from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import make_evaluator, make_stacked_local_update
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
-from dopt.parallel.collectives import broadcast_to_workers, masked_average
+from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
+                                        where_mask as _where_mask)
 from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
-
-
-def _where_mask(mask, a, b):
-    """Per-worker select over stacked pytrees: mask[i] ? a_i : b_i."""
-    def sel(x, y):
-        m = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(bool)
-        return jnp.where(m, x, y)
-    return jax.tree.map(sel, a, b)
 
 
 class FederatedTrainer:
@@ -194,7 +187,11 @@ class FederatedTrainer:
                 )(duals, p_t)
                 new_duals = _where_mask(mask, ascended, duals)
             new_p = _where_mask(mask, p_t, params)
-            new_m = _where_mask(mask, m_t, mom)
+            # Scaffold momentum is per-round-local (fresh buffer each
+            # round), so the carried buffer stays untouched zeros and is
+            # not checkpointed; the other algorithms persist it like the
+            # reference's lifetime client optimizers.
+            new_m = mom if algorithm == "scaffold" else _where_mask(mask, m_t, mom)
             new_theta = masked_average(new_p, mask)
             evalm = global_eval(new_theta, ex, ey, ew)
             if eval_train_flag:
@@ -276,8 +273,11 @@ class FederatedTrainer:
         — without it, round t after resume replays round 0's sample."""
         from dopt.utils.checkpoint import save_checkpoint
 
-        arrays = {"theta": self.theta, "params": self.params,
-                  "momentum": self.momentum}
+        arrays = {"theta": self.theta, "params": self.params}
+        if self.cfg.federated.algorithm != "scaffold":
+            # Scaffold momentum is per-round-local (always zeros between
+            # rounds) — no point persisting a model-sized zero tree.
+            arrays["momentum"] = self.momentum
         if self.duals is not None:
             arrays["duals"] = self.duals
         if self.c_global is not None:
@@ -306,7 +306,8 @@ class FederatedTrainer:
             )
         self.theta = arrays["theta"]
         self.params = shard_worker_tree(arrays["params"], self.mesh)
-        self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
+        if "momentum" in arrays:
+            self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
         if "duals" in arrays and self.duals is not None:
             self.duals = shard_worker_tree(arrays["duals"], self.mesh)
         if self.c_global is not None:

@@ -35,10 +35,12 @@ from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update,
                                make_stacked_local_update_gather)
 from dopt.models import build_model, count_params
-from dopt.parallel.collectives import broadcast_to_workers, mix_power
+from dopt.parallel.collectives import (broadcast_to_workers, mix_power,
+                                       where_mask)
 from dopt.parallel.mesh import (WORKER_AXIS, fit_mesh_devices, make_mesh,
                                 shard_worker_tree, worker_sharding)
-from dopt.topology import MixingMatrices, build_mixing_matrices
+from dopt.topology import (MixingMatrices, build_mixing_matrices,
+                           repair_for_dropout)
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
@@ -143,6 +145,11 @@ class GossipTrainer:
             self.mixing = None
 
         self._matching_rng = host_rng(cfg.seed, 60551)
+        # Fault injection (worker dropout): draw per-round alive masks on
+        # the host; the mixing matrix is repaired as data and dead lanes
+        # keep their state via where_mask (elastic rejoin).
+        self._dropout_rng = host_rng(cfg.seed, 424242)
+        has_dropout = g.dropout > 0.0
 
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
@@ -159,8 +166,16 @@ class GossipTrainer:
             z = jnp.zeros(self.num_workers)
             return {"acc": z, "loss_sum": z, "loss_mean": z, "count": z}
 
-        def round_fn(params, mom, w_matrix, idx, bweight, train_x, train_y,
-                     ex, ey, ew, do_eval):
+        def train_metrics(losses, accs, alive):
+            """Mean over steps per worker, then over ALIVE workers only."""
+            if not has_dropout:
+                return losses.mean(), accs.mean()
+            denom = jnp.maximum(alive.sum(), 1.0)
+            return ((losses.mean(axis=1) * alive).sum() / denom,
+                    (accs.mean(axis=1) * alive).sum() / denom)
+
+        def round_fn(params, mom, w_matrix, alive, idx, bweight,
+                     train_x, train_y, ex, ey, ew, do_eval):
             if do_mix:
                 params = mix_power(params, w_matrix, eps=eps, mesh=mesh)
             evalm = jax.lax.cond(
@@ -170,8 +185,14 @@ class GossipTrainer:
             )
             bx = train_x[idx]
             by = train_y[idx]
-            params, mom, losses, accs = local(params, mom, bx, by, bweight)
-            return params, mom, losses.mean(), accs.mean(), evalm
+            p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
+            if has_dropout:
+                # Dead workers skip the local update (their lanes compute
+                # and are discarded — static shapes).
+                p_t = where_mask(alive, p_t, params)
+                m_t = where_mask(alive, m_t, mom)
+            tl, ta = train_metrics(losses, accs, alive)
+            return p_t, m_t, tl, ta, evalm
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
         self._sharding = worker_sharding(self.mesh)
@@ -185,8 +206,8 @@ class GossipTrainer:
         )
         local_g, ev = self._local_gather, self._evaluator
 
-        def block_fn(params, mom, w_mats, idx, bw, is_eval, train_x, train_y,
-                     ex, ey, ew):
+        def block_fn(params, mom, w_mats, alive, idx, bw, is_eval,
+                     train_x, train_y, ex, ey, ew):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -197,15 +218,20 @@ class GossipTrainer:
 
             def body(carry, xs):
                 p, m = carry
-                w_t, idx_t, bw_t, ev_t = xs
+                w_t, alive_t, idx_t, bw_t, ev_t = xs
                 if do_mix:
                     p = mix_power(p, w_t, eps=eps, mesh=mesh)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
-                p, m, losses, accs = local_g(p, m, idx_t, bw_t, train_x, train_y)
-                return (p, m), (losses.mean(), accs.mean(), evalm)
+                p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t,
+                                                 train_x, train_y)
+                if has_dropout:
+                    p_t = where_mask(alive_t, p_t, p)
+                    m_t = where_mask(alive_t, m_t, m)
+                tl, ta = train_metrics(losses, accs, alive_t)
+                return (p_t, m_t), (tl, ta, evalm)
 
             (params, mom), (tl, ta, evalms) = jax.lax.scan(
-                body, (params, mom), (w_mats, idx, bw, is_eval)
+                body, (params, mom), (w_mats, alive, idx, bw, is_eval)
             )
             return params, mom, tl, ta, evalms
 
@@ -223,9 +249,9 @@ class GossipTrainer:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
             with self.timers.phase("host_batch_plan"):
-                w_mats = np.stack(
-                    [self._matrix_for_round(t) for t in ts]
-                ).astype(np.float32)
+                pairs = [self._round_inputs(t) for t in ts]
+                w_mats = np.stack([p[0] for p in pairs])
+                alive = np.stack([p[1] for p in pairs])
                 plans = [
                     make_batch_plan(self.index_matrix, batch_size=g.local_bs,
                                     local_ep=g.local_ep, seed=cfg.seed,
@@ -241,7 +267,7 @@ class GossipTrainer:
             )
             self.params, self.momentum, tl, ta, evalms = self.timers.measure(
                 "round_step", self._block_fn,
-                self.params, self.momentum, w_mats, idx, bw,
+                self.params, self.momentum, w_mats, alive, idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
                 *self._eval,
             )
@@ -272,6 +298,25 @@ class GossipTrainer:
             return self.mixing.for_round(t)
         return np.eye(self.num_workers)
 
+    def _alive_for_round(self) -> np.ndarray:
+        """Per-round fault injection: 0/1 alive mask (all alive when
+        cfg.gossip.dropout == 0; stateful host RNG so per-round and
+        blocked execution draw the same failure sequence)."""
+        g = self.cfg.gossip
+        if g.dropout <= 0.0:
+            return np.ones(self.num_workers, np.float32)
+        return (self._dropout_rng.random(self.num_workers)
+                >= g.dropout).astype(np.float32)
+
+    def _round_inputs(self, t: int) -> tuple[np.ndarray, np.ndarray]:
+        """(mixing matrix, alive mask) for round t, with the matrix
+        repaired for any failed workers."""
+        w_t = self._matrix_for_round(t)
+        alive = self._alive_for_round()
+        if alive.min() < 1.0:
+            w_t = repair_for_dropout(w_t, alive)
+        return w_t.astype(np.float32), alive
+
     def run(self, rounds: int | None = None, eps: int | None = None,
             block: int | None = None) -> History:
         """Train; mirrors ``Simulator.run(rounds)`` / ``FedLCon.run(rounds, eps)``.
@@ -291,7 +336,7 @@ class GossipTrainer:
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                w_t = self._matrix_for_round(t)
+                w_t, alive = self._round_inputs(t)
                 plan = make_batch_plan(
                     self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
@@ -302,7 +347,7 @@ class GossipTrainer:
             self.params, self.momentum, train_loss, train_acc, evalm = (
                 self.timers.measure(
                     "round_step", self._round_fn,
-                    self.params, self.momentum, w_t, idx, bweight,
+                    self.params, self.momentum, w_t, alive, idx, bweight,
                     self._train_x, self._train_y, *self._eval, do_eval,
                 )
             )
@@ -332,7 +377,8 @@ class GossipTrainer:
             meta={"round": self.round, "name": self.cfg.name,
                   "algorithm": self.cfg.gossip.algorithm,
                   "history": self.history.rows,
-                  "matching_rng_state": self._matching_rng.bit_generator.state},
+                  "matching_rng_state": self._matching_rng.bit_generator.state,
+                  "dropout_rng_state": self._dropout_rng.bit_generator.state},
         )
 
     def restore(self, path) -> None:
@@ -351,6 +397,8 @@ class GossipTrainer:
         self.history.rows = list(meta.get("history", []))
         if meta.get("matching_rng_state"):
             self._matching_rng.bit_generator.state = meta["matching_rng_state"]
+        if meta.get("dropout_rng_state"):
+            self._dropout_rng.bit_generator.state = meta["dropout_rng_state"]
 
     # Convenience: per-worker eval of the current state.
     def evaluate(self) -> dict[str, np.ndarray]:

@@ -191,6 +191,8 @@ class OracleWorker:
                      c_global: Mapping | None = None) -> float:
         """Run the batch-plan steps: bx [S,B,C,H,W] (NCHW), by [S,B],
         bw [S,B] padding weights.  Returns mean loss."""
+        if self.algorithm == "scaffold" and c_global is None:
+            raise ValueError("scaffold local_update requires c_global")
         losses = []
         theta_t = ({k: v.detach().clone() for k, v in theta.items()}
                    if theta is not None else None)

@@ -100,6 +100,16 @@ def mix_shifts_shardmap(stacked, shifts, mesh: Mesh):
     return jax.tree.map(mix_leaf, stacked)
 
 
+def where_mask(mask, a, b):
+    """Per-worker select over stacked pytrees: mask[i] ? a_i : b_i.
+    Used for client-sampling (federated) and worker-dropout (gossip)
+    participation masks."""
+    def sel(x, y):
+        m = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(bool)
+        return jnp.where(m, x, y)
+    return jax.tree.map(sel, a, b)
+
+
 def masked_average(stacked, mask):
     """Uniform average of the masked workers' states, replicated back to
     every worker: theta ← Σ_i m_i x_i / Σ_i m_i  (reference

@@ -323,3 +323,27 @@ def shift_decomposition(w: np.ndarray, max_shifts: int | None = None
     if max_shifts is not None and len(shifts) > max_shifts:
         return None
     return shifts
+
+
+def repair_for_dropout(w: np.ndarray, alive: np.ndarray) -> np.ndarray:
+    """Rebuild a mixing matrix after worker failures (fault injection /
+    elastic recovery — the subsystem SURVEY §5 notes the reference lacks
+    entirely; here failures are a per-round participation mask and the
+    communication layer heals itself as data).
+
+    ``alive`` is a 0/1 vector.  Edges to dead workers are removed and
+    surviving rows renormalised to keep row-stochasticity; a live worker
+    whose neighbors all died keeps its own weights for the round
+    (identity row), and a dead worker is frozen (identity row) so it
+    rejoins with stale-but-valid parameters when it comes back.
+    """
+    n = w.shape[0]
+    a = np.asarray(alive, dtype=w.dtype).reshape(1, n)
+    masked = w * a                       # drop edges into dead workers
+    rowsum = masked.sum(axis=1, keepdims=True)
+    safe = np.where(rowsum > 0, rowsum, 1.0)
+    repaired = masked / safe
+    isolated = np.nonzero((rowsum[:, 0] <= 0) | (np.asarray(alive) <= 0))[0]
+    repaired[isolated, :] = 0.0
+    repaired[isolated, isolated] = 1.0
+    return repaired

@@ -210,3 +210,30 @@ def test_blocked_run_matches_per_round(devices):
     c.run(rounds=4, block=3)
     fc = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(c.params))])
     np.testing.assert_array_equal(fa, fc)
+
+
+def test_gossip_dropout_runs_and_learns(devices):
+    tr = GossipTrainer(_gossip_cfg(gossip={"dropout": 0.3}))
+    h = tr.run(rounds=4)
+    assert h["avg_test_acc"][-1] > 0.5
+
+
+def test_gossip_full_dropout_freezes_state(devices):
+    import jax
+    tr = GossipTrainer(_gossip_cfg(gossip={"dropout": 1.0}))
+    before = jax.device_get(tr.params)
+    tr.run(rounds=2)
+    after = jax.device_get(tr.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gossip_dropout_blocked_matches_per_round(devices):
+    import jax
+    a = GossipTrainer(_gossip_cfg(gossip={"dropout": 0.4}))
+    a.run(rounds=4)
+    b = GossipTrainer(_gossip_cfg(gossip={"dropout": 0.4}))
+    b.run(rounds=4, block=2)
+    fa = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(a.params))])
+    fb = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(b.params))])
+    np.testing.assert_array_equal(fa, fb)

@@ -152,3 +152,41 @@ def test_stacked_shape():
     mm = build_mixing_matrices("dynamic", "stochastic", 6, seed=0)
     assert mm.stacked().shape == (6, 6, 6)
     assert isinstance(mm, MixingMatrices)
+
+
+def test_repair_for_dropout_invariants():
+    from dopt.topology import repair_for_dropout
+
+    for topo, mode in [("complete", "uniform"), ("circle", "metropolis"),
+                       ("star", "stochastic")]:
+        w = build_mixing_matrices(topo, mode, 8, seed=3).matrices[0]
+        alive = np.array([1, 0, 1, 1, 0, 1, 1, 0], float)
+        r = repair_for_dropout(w, alive)
+        # rows still stochastic
+        np.testing.assert_allclose(r.sum(axis=1), 1.0, atol=1e-12)
+        # no edges INTO dead workers from live rows
+        dead = np.nonzero(alive == 0)[0]
+        live = np.nonzero(alive == 1)[0]
+        assert np.all(r[np.ix_(live, dead)] == 0), (topo, mode)
+        # dead rows frozen to identity
+        for i in dead:
+            row = np.zeros(8); row[i] = 1.0
+            np.testing.assert_array_equal(r[i], row)
+
+
+def test_repair_for_dropout_isolated_live_worker():
+    from dopt.topology import repair_for_dropout
+
+    # star, leaf workers only talk to the hub; kill the hub → every
+    # zero-diagonal leaf row would be empty and must fall back to self.
+    w = build_mixing_matrices("star", "stochastic", 6, seed=0).matrices[0]
+    alive = np.ones(6); alive[0] = 0  # hub is worker 0
+    r = repair_for_dropout(w, alive)
+    np.testing.assert_allclose(r, np.eye(6))
+
+
+def test_repair_for_dropout_all_alive_identity_op():
+    from dopt.topology import repair_for_dropout
+
+    w = build_mixing_matrices("circle", "stochastic", 8, seed=1).matrices[0]
+    np.testing.assert_allclose(repair_for_dropout(w, np.ones(8)), w)
