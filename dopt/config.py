@@ -117,6 +117,11 @@ class ExperimentConfig:
     # Mesh shape: workers are folded onto devices; workers_per_device>1
     # vmaps multiple worker lanes onto one chip (SURVEY §7 hard parts).
     mesh_devices: int | None = None   # None -> all available
+    mesh_hosts: int | None = None
+    # None -> 1-D worker mesh.  Set to H for a 2-D (hosts × ici) hybrid
+    # mesh (dopt.parallel.multihost): on a real multi-slice job the
+    # outer axis crosses DCN; single-process it partitions local devices
+    # into H virtual hosts (same program, testable anywhere).
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -41,7 +41,7 @@ from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
                                         where_mask as _where_mask)
-from dopt.parallel.mesh import fit_mesh_devices, make_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.mesh import make_worker_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
@@ -73,7 +73,7 @@ class FederatedTrainer:
 
         w = cfg.data.num_users
         self.num_workers = w
-        self.mesh = make_mesh(fit_mesh_devices(w, cfg.mesh_devices))
+        self.mesh = make_worker_mesh(w, cfg.mesh_devices, cfg.mesh_hosts)
         self._sharding = worker_sharding(self.mesh)
 
         self.dataset = load_dataset(

@@ -37,8 +37,8 @@ from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update
 from dopt.models import build_model, count_params
 from dopt.parallel.collectives import (broadcast_to_workers, mix_power,
                                        where_mask)
-from dopt.parallel.mesh import (WORKER_AXIS, fit_mesh_devices, make_mesh,
-                                shard_worker_tree, worker_sharding)
+from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
+                                worker_axes, worker_sharding)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
                            repair_for_dropout)
 from dopt.utils.metrics import History
@@ -102,7 +102,7 @@ class GossipTrainer:
 
         w = cfg.data.num_users
         self.num_workers = w
-        self.mesh = make_mesh(fit_mesh_devices(w, cfg.mesh_devices))
+        self.mesh = make_worker_mesh(w, cfg.mesh_devices, cfg.mesh_hosts)
 
         # Data: load, partition, upload once.
         self.dataset = load_dataset(
@@ -241,7 +241,7 @@ class GossipTrainer:
         """Run ``rounds`` rounds in fused blocks of up to ``block``."""
         cfg, g = self.cfg, self.cfg.gossip
         block_sharding = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(None, WORKER_AXIS)
+            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
         )
         t0 = time.time()
         done = 0

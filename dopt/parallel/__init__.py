@@ -1,11 +1,18 @@
-from dopt.parallel.mesh import make_mesh, shard_worker_tree, worker_sharding
 from dopt.parallel.collectives import masked_average, mix_dense, mix_shifts_shardmap
+from dopt.parallel.mesh import (make_mesh, make_worker_mesh, shard_worker_tree,
+                                worker_sharding)
+from dopt.parallel.multihost import (dcn_edge_count, initialize_distributed,
+                                     make_hybrid_mesh)
 
 __all__ = [
     "make_mesh",
+    "make_worker_mesh",
     "shard_worker_tree",
     "worker_sharding",
     "masked_average",
     "mix_dense",
     "mix_shifts_shardmap",
+    "initialize_distributed",
+    "make_hybrid_mesh",
+    "dcn_edge_count",
 ]

@@ -49,9 +49,9 @@ def mix_dense(stacked, w_matrix, mesh: Mesh | None = None):
         y = jnp.tensordot(w.astype(x.dtype), x, axes=[[1], [0]])
         y = y.astype(x.dtype)
         if mesh is not None:
-            y = jax.lax.with_sharding_constraint(
-                y, jax.sharding.NamedSharding(mesh, P(WORKER_AXIS))
-            )
+            from dopt.parallel.mesh import worker_sharding
+
+            y = jax.lax.with_sharding_constraint(y, worker_sharding(mesh))
         return y
 
     return jax.tree.map(mix_leaf, stacked)

@@ -39,10 +39,17 @@ def fit_mesh_devices(num_workers: int, requested: int | None = None) -> int:
     return d
 
 
+def worker_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The mesh axis names the logical worker axis folds over: just
+    ``workers`` on a 1-D mesh, ``(hosts, ici)`` on a hybrid mesh
+    (dopt.parallel.multihost)."""
+    return tuple(mesh.axis_names)
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (worker) axis across the mesh; everything else
-    replicated within a worker shard."""
-    return NamedSharding(mesh, P(WORKER_AXIS))
+    """Shard the leading (worker) axis across ALL mesh axes; everything
+    else replicated within a worker shard."""
+    return NamedSharding(mesh, P(worker_axes(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -64,3 +71,42 @@ def shard_worker_tree(tree, mesh: Mesh):
         return jax.device_put(x, sh)
 
     return jax.tree.map(put, tree)
+
+
+def make_worker_mesh(num_workers: int, mesh_devices: int | None = None,
+                     mesh_hosts: int | None = None) -> Mesh:
+    """The engines' mesh factory: 1-D worker mesh by default, 2-D
+    (hosts × ici) hybrid mesh when ``mesh_hosts`` is set
+    (dopt.parallel.multihost)."""
+    if not mesh_hosts:
+        return make_mesh(fit_mesh_devices(num_workers, mesh_devices))
+
+    from dopt.parallel.multihost import make_hybrid_mesh
+
+    devices = jax.devices()
+    if jax.process_count() > 1:
+        # On a real multi-controller job every process's devices must be
+        # in the mesh, and slicing would break the host-row alignment
+        # make_hybrid_mesh relies on — use all devices or nothing.
+        n = len(devices)
+        if mesh_devices not in (None, n):
+            raise ValueError(
+                f"multi-host jobs must use all {n} devices "
+                f"(got mesh_devices={mesh_devices})")
+        if num_workers % n:
+            raise ValueError(
+                f"{num_workers} workers do not fold evenly onto the "
+                f"{n} devices of this multi-host job")
+        return make_hybrid_mesh(mesh_hosts, devices=devices)
+
+    # Single process (incl. virtual-host testing): largest device count
+    # that divides the workers AND splits evenly into the virtual hosts.
+    avail = len(devices) if mesh_devices is None else mesh_devices
+    d = min(num_workers, avail)
+    while d > 0 and (num_workers % d or d % mesh_hosts):
+        d -= 1
+    if d <= 0:
+        raise ValueError(
+            f"no device count <= {avail} folds {num_workers} workers "
+            f"onto {mesh_hosts} hosts")
+    return make_hybrid_mesh(mesh_hosts, devices=devices[:d])
