@@ -72,6 +72,12 @@ class FederatedConfig:
     rounds: int = 20
     local_ep: int = 10
     local_bs: int = 50
+    compact: bool | None = None
+    # Compact-sampling fast path: gather the m sampled workers' state
+    # into [m, ...] lanes, train only those, scatter back — instead of
+    # training all N lanes and mask-discarding (the faithful wart).
+    # None = auto (on for a single-device mesh when frac < 1); numerics
+    # match the full-width path up to float summation order.
 
 
 @dataclass(frozen=True)

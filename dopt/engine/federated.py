@@ -15,10 +15,13 @@ mesh engine:
 * FedProx / FedADMM are gradient edits inside the local scan; the ADMM
   duals are a worker-stacked (sharded) pytree with dual ascent after the
   local epochs (clients.py:141-144), only for sampled workers.
-* Faithful wart, kept deliberately: ALL workers compute a local update
-  and the mask discards the unsampled results.  With frac=0.1 this
-  wastes lanes but keeps shapes static; a gather-compact path is a
-  planned fast-mode optimisation.
+* Two execution paths, same math: the full-width path trains ALL N
+  lanes and mask-discards the unsampled results (static shapes, right
+  for sharded meshes where lanes are parallel hardware anyway), and the
+  compact-sampling fast path (``FederatedConfig.compact``, auto-on for
+  single-device meshes) gathers the m sampled workers into [m, ...]
+  lanes, trains only those, and scatters back — an ~N/m compute saving
+  at frac = m/N.
 
 History schema is P1's: round, test_acc, test_loss (global model on the
 test set), train_loss, train_acc (mean over ALL clients of their own
@@ -143,49 +146,70 @@ class FederatedTrainer:
         momentum_coef = cfg.optim.momentum
         eval_train_flag = eval_train
 
-        def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
-                     train_x, train_y, ex, ey, ew, tidx, tweight):
-            bx = train_x[idx]
-            by = train_y[idx]
-            theta_b = broadcast_to_workers(theta, w)
-            start = _where_mask(mask, theta_b, params)
-            new_c = c_global
+        def algo_step(theta, start, mom_in, duals_in, c_global, bx, by, bw):
+            """Local update + companion-state refresh on however many
+            lanes the inputs carry (all N for the full-width path, the m
+            sampled for the compact path).  Returns (p_t, m_t, losses,
+            accs, sub_new) where sub_new is the updated companion state
+            for THESE lanes (ADMM duals after ascent / SCAFFOLD controls
+            after the option-II refresh; unchanged for fedavg/fedprox).
+            The caller masks or scatters sub_new back into the
+            worker-stacked state and forms the server-control update."""
             if algorithm == "fedavg":
-                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight)
-                new_duals = duals
+                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw)
+                sub_new = duals_in
             elif algorithm == "fedprox":
-                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight, theta)
-                new_duals = duals
+                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
+                                               theta)
+                sub_new = duals_in
             elif algorithm == "scaffold":
                 # Sampled workers restart from theta with a FRESH momentum
                 # buffer so theta − y_i reflects only this round's
                 # gradients (no stale-round momentum in the control
                 # refresh); effective step size lr/(1−μ) accounts for
                 # heavy-ball amplification of the displacement.
-                mom0 = jax.tree.map(jnp.zeros_like, mom)
-                p_t, m_t, losses, accs = local(start, mom0, bx, by, bweight,
-                                               c_global, duals)
-                steps = bweight.shape[1]
+                mom0 = jax.tree.map(jnp.zeros_like, mom_in)
+                p_t, m_t, losses, accs = local(start, mom0, bx, by, bw,
+                                               c_global, duals_in)
+                steps = bw.shape[1]
                 lr_eff = lr / max(1.0 - momentum_coef, 1e-8)
-                refreshed = jax.vmap(
+                sub_new = jax.vmap(
                     lambda ci, y: scaffold_control_update(
                         ci, c_global, theta, y, lr=lr_eff, num_steps=steps),
                     in_axes=(0, 0),
-                )(duals, p_t)
-                new_duals = _where_mask(mask, refreshed, duals)
-                # c ← c + (1/N)·Σ_{i∈S}(c_i⁺ − c_i); unsampled deltas are 0.
-                new_c = jax.tree.map(
-                    lambda c, dn, do: c + (dn - do).sum(axis=0) / w,
-                    c_global, new_duals, duals,
-                )
+                )(duals_in, p_t)
             else:
-                p_t, m_t, losses, accs = local(start, mom, bx, by, bweight,
-                                               theta, duals)
-                ascended = jax.vmap(
+                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
+                                               theta, duals_in)
+                sub_new = jax.vmap(
                     lambda a, p: admm_dual_ascent(a, p, theta, rho),
                     in_axes=(0, 0),
-                )(duals, p_t)
-                new_duals = _where_mask(mask, ascended, duals)
+                )(duals_in, p_t)
+            return p_t, m_t, losses, accs, sub_new
+
+        def control_delta(c_global, sub_new, sub_old):
+            """SCAFFOLD server control: c ← c + (1/N)·Σ_{i∈S}(c_i⁺ − c_i);
+            the caller passes lane sets where non-sampled deltas are 0
+            (full-width, post-mask) or absent (compact)."""
+            return jax.tree.map(
+                lambda c, dn, do: c + (dn - do).sum(axis=0) / w,
+                c_global, sub_new, sub_old,
+            )
+
+        def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
+                     train_x, train_y, ex, ey, ew, tidx, tweight):
+            bx = train_x[idx]
+            by = train_y[idx]
+            theta_b = broadcast_to_workers(theta, w)
+            start = _where_mask(mask, theta_b, params)
+            p_t, m_t, losses, accs, sub_new = algo_step(
+                theta, start, mom, duals, c_global, bx, by, bweight)
+            if algorithm in ("scaffold", "fedadmm"):
+                new_duals = _where_mask(mask, sub_new, duals)
+            else:
+                new_duals = duals
+            new_c = (control_delta(c_global, new_duals, duals)
+                     if algorithm == "scaffold" else c_global)
             new_p = _where_mask(mask, p_t, params)
             # Scaffold momentum is per-round-local (fresh buffer each
             # round), so the carried buffer stays untouched zeros and is
@@ -211,42 +235,119 @@ class FederatedTrainer:
             in_axes=(0, 0, 0, 0),
         )
 
+        def _take(tree, sel):
+            return jax.tree.map(lambda x: x[sel], tree)
+
+        def _scatter(tree, sel, sub):
+            return jax.tree.map(lambda x, s: x.at[sel].set(s), tree, sub)
+
+        def compact_round_fn(theta, params, mom, duals, c_global, sel,
+                             idx_sel, bw_sel, train_x, train_y, ex, ey, ew,
+                             tidx, tweight):
+            """Compact-sampling fast path: only the m = len(sel) sampled
+            workers' lanes are trained ([m, ...] gather → local update →
+            scatter-back), instead of all N lanes computing and the mask
+            discarding N−m results.  Identical math to ``round_fn`` up to
+            float summation order (the sampled average sums m terms
+            directly rather than N mask-weighted ones)."""
+            m = sel.shape[0]
+            bx = train_x[idx_sel]
+            by = train_y[idx_sel]
+            start = broadcast_to_workers(theta, m)
+            duals_sel = _take(duals, sel)
+            p_t, m_t, losses, accs, sub_new = algo_step(
+                theta, start, _take(mom, sel), duals_sel, c_global,
+                bx, by, bw_sel)
+            if algorithm in ("scaffold", "fedadmm"):
+                new_duals = _scatter(duals, sel, sub_new)
+            else:
+                new_duals = duals
+            new_c = (control_delta(c_global, sub_new, duals_sel)
+                     if algorithm == "scaffold" else c_global)
+            new_p = _scatter(params, sel, p_t)
+            new_m = mom if algorithm == "scaffold" else _scatter(mom, sel, m_t)
+            new_theta = jax.tree.map(lambda x: x.mean(axis=0), p_t)
+            evalm = global_eval(new_theta, ex, ey, ew)
+            if eval_train_flag:
+                tx = train_x[tidx]
+                ty = train_y[tidx]
+                trainm = stacked_eval_perworker(new_p, tx, ty, tweight)
+            else:
+                trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
+                          "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
+            local_loss = losses.mean()
+            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
+                    evalm, trainm)
+
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
+        self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
         self._global_eval = jax.jit(global_eval)
         self._sample_rng = host_rng(cfg.seed, 314159)
 
     # ------------------------------------------------------------------
-    def sample_clients(self, frac: float) -> np.ndarray:
+    def _sample_indices(self, frac: float) -> np.ndarray:
         """m = max(int(frac*N), 1) clients without replacement
-        (servers.py:52,57) as a 0/1 mask."""
+        (servers.py:52,57), as sorted indices."""
         m = max(int(frac * self.num_workers), 1)
         chosen = self._sample_rng.choice(self.num_workers, m, replace=False)
+        return np.sort(chosen).astype(np.int32)
+
+    def sample_clients(self, frac: float) -> np.ndarray:
+        """Client sample as a 0/1 mask over the worker axis."""
         mask = np.zeros(self.num_workers, np.float32)
-        mask[chosen] = 1.0
+        mask[self._sample_indices(frac)] = 1.0
         return mask
+
+    def _use_compact(self, frac: float) -> bool:
+        f = self.cfg.federated
+        m = max(int(frac * self.num_workers), 1)
+        if m >= self.num_workers:
+            return False
+        if self.mesh.size > 1:
+            # The compact path re-shapes the worker axis to m lanes and
+            # never applies the mesh sharding — single-device only; on a
+            # sharded mesh the N lanes are parallel hardware, so the
+            # full-width path is the right one anyway.
+            if f.compact:
+                raise ValueError(
+                    "FederatedConfig.compact=True requires a single-device "
+                    f"mesh (have {self.mesh.size} devices)")
+            return False
+        if f.compact is not None:
+            return f.compact
+        return True
 
     def run(self, frac: float | None = None, rounds: int | None = None) -> History:
         cfg, f = self.cfg, self.cfg.federated
         frac = f.frac if frac is None else frac
         rounds = f.rounds if rounds is None else rounds
+        compact = self._use_compact(frac)
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                mask = self.sample_clients(frac)
+                sel = self._sample_indices(frac)
                 plan = make_batch_plan(
                     self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                 )
-                idx = jax.device_put(plan.idx, self._sharding)
-                bweight = jax.device_put(plan.weight, self._sharding)
+                if compact:
+                    idx = jnp.asarray(plan.idx[sel])
+                    bweight = jnp.asarray(plan.weight[sel])
+                else:
+                    mask = np.zeros(self.num_workers, np.float32)
+                    mask[sel] = 1.0
+                    idx = jax.device_put(plan.idx, self._sharding)
+                    bweight = jax.device_put(plan.weight, self._sharding)
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
+            step_fn = self._compact_fn if compact else self._round_fn
+            gate = jnp.asarray(sel) if compact else jnp.asarray(mask)
             (self.theta, self.params, self.momentum, new_duals, new_c,
              local_loss, evalm, trainm) = self.timers.measure(
-                "round_step", self._round_fn,
+                "round_step", step_fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
-                jnp.asarray(mask), idx, bweight,
+                gate, idx, bweight,
                 self._train_x, self._train_y, *self._eval,
                 self._train_eval_idx, self._train_eval_w,
             )

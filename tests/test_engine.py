@@ -237,3 +237,59 @@ def test_gossip_dropout_blocked_matches_per_round(devices):
     fa = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(a.params))])
     fb = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(b.params))])
     np.testing.assert_array_equal(fa, fb)
+
+
+def test_fedlcon_faithful_bug_reproduces_single_sweep(devices):
+    # The reference's FedLCon never clears new_weights across its eps
+    # loop, so every sweep reloads sweep-0 results — effectively ONE
+    # consensus sweep (simulators.py:189-196). faithful_bugs=True must
+    # reproduce that exactly; the fixed path must differ.
+    import jax
+
+    def params_of(**gk):
+        tr = GossipTrainer(_gossip_cfg(gossip=dict(
+            algorithm="fedlcon", topology="circle", mode="metropolis", **gk)))
+        tr.run(rounds=2)
+        return np.concatenate([np.ravel(np.asarray(x))
+                               for x in jax.tree.leaves(jax.device_get(tr.params))])
+
+    buggy_eps3 = params_of(eps=3, faithful_bugs=True)
+    one_sweep = params_of(eps=1)
+    fixed_eps3 = params_of(eps=3)
+    np.testing.assert_array_equal(buggy_eps3, one_sweep)
+    assert not np.array_equal(fixed_eps3, one_sweep)
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox", "fedadmm",
+                                       "scaffold"])
+def test_compact_sampling_matches_full_width(devices, algorithm):
+    # The gather-compact fast path must reproduce the full-width masked
+    # path up to float summation order, for every algorithm, including
+    # stale state on unsampled workers across rounds.
+    import jax
+
+    def run(compact):
+        cfg = _fed_cfg(algorithm)
+        cfg = cfg.replace(federated=dataclasses.replace(
+            cfg.federated, compact=compact), mesh_devices=1)
+        tr = FederatedTrainer(cfg)
+        tr.run(rounds=3)
+        return tr
+
+    a = run(False)
+    b = run(True)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.theta)),
+                    jax.tree.leaves(jax.device_get(b.theta))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=2e-5, rtol=1e-4)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=2e-5, rtol=1e-4)
+    if a.duals is not None:
+        for x, y in zip(jax.tree.leaves(jax.device_get(a.duals)),
+                        jax.tree.leaves(jax.device_get(b.duals))):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(a.history["test_acc"], b.history["test_acc"],
+                               atol=1e-3)
