@@ -6,6 +6,19 @@ data, non-IID 2 shards/user, local_ep=4, local_bs=128, circle topology,
 stochastic mixing) and measures steady-state gossip rounds per second on
 the available accelerator.
 
+Two modes are measured in one run:
+  * fast      — the TPU-native configuration: bfloat16 compute, native
+                C++ batch planner, all rounds fused into one lax.scan
+                dispatch.  This is the headline number.
+  * faithful  — float32 with the numpy (PCG64) batch planner: the
+                torch-oracle-parity configuration.  Reported alongside.
+Both train the identical faithful objective (double-softmax head),
+algorithm, round order (consensus → eval → local epochs), data
+partition, and mixing matrices.  The modes differ in compute dtype AND
+in batch order (the native planner draws from its own xoshiro stream),
+so the reported accuracies are a sanity check that the fast mode trains
+equally well — not a controlled single-variable dtype ablation.
+
 Baseline: the reference runs ~10 rounds in ~800s on Colab
 (BASELINE.md: "Gossip throughput (derived) ~0.012 rounds/s").  Data is
 synthetic at exactly MNIST scale (60,000 train / 10,000 test samples,
@@ -13,7 +26,7 @@ synthetic at exactly MNIST scale (60,000 train / 10,000 test samples,
 FLOPs and communication volume match the real workload.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
@@ -26,6 +39,43 @@ import time
 REFERENCE_ROUNDS_PER_SEC = 0.012  # BASELINE.md derived gossip throughput
 
 
+def _config(*, fast: bool, train_size: int, test_size: int):
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+
+    return ExperimentConfig(
+        name="bench-dsgd-mnist" + ("-fast" if fast else "-faithful"),
+        seed=2028,
+        data=DataConfig(dataset="mnist", num_users=6, iid=False, shards=2,
+                        synthetic_train_size=train_size,
+                        synthetic_test_size=test_size,
+                        plan_impl="native" if fast else "numpy"),
+        model=ModelConfig(model="model1", faithful=True,
+                          compute_dtype="bfloat16" if fast else "float32"),
+        optim=OptimizerConfig(lr=0.01, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="stochastic", rounds=10, local_ep=4,
+                            local_bs=128),
+    )
+
+
+def _measure(cfg, rounds: int, block: int):
+    """Warm up (compile), then time ``rounds`` rounds. Returns
+    (rounds/sec, last avg_test_acc, elapsed seconds)."""
+    from dopt.engine import GossipTrainer
+
+    trainer = GossipTrainer(cfg)
+    # Warmup: compile the fused block step for every block size the
+    # measured loop will dispatch (the remainder block retraces).
+    trainer.run(rounds=block, block=block)
+    if rounds % block:
+        trainer.run(rounds=rounds % block, block=block)
+    t0 = time.time()
+    trainer.run(rounds=rounds, block=block)
+    elapsed = time.time() - t0
+    return rounds / elapsed, trainer.history.last().get("avg_test_acc"), elapsed
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -34,55 +84,38 @@ def main() -> None:
     ap.add_argument("--block", type=int, default=None,
                     help="rounds fused per jit dispatch (default: all "
                          "measured rounds in one fused lax.scan block)")
+    ap.add_argument("--skip-faithful", action="store_true",
+                    help="measure only the fast (bf16) mode")
     args = ap.parse_args()
-
-    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
-                             ModelConfig, OptimizerConfig)
-    from dopt.engine import GossipTrainer
 
     train_size = 6_000 if args.smoke else 60_000
     test_size = 1_000 if args.smoke else 10_000
-    measure_rounds = args.rounds or (3 if args.smoke else 10)
+    rounds = args.rounds or (3 if args.smoke else 10)
+    block = args.block or rounds
 
-    cfg = ExperimentConfig(
-        name="bench-dsgd-mnist",
-        seed=2028,
-        data=DataConfig(dataset="mnist", num_users=6, iid=False, shards=2,
-                        synthetic_train_size=train_size,
-                        synthetic_test_size=test_size),
-        model=ModelConfig(model="model1", faithful=True),
-        optim=OptimizerConfig(lr=0.01, momentum=0.5),
-        gossip=GossipConfig(algorithm="dsgd", topology="circle",
-                            mode="stochastic", rounds=10, local_ep=4,
-                            local_bs=128),
-    )
-    trainer = GossipTrainer(cfg)
-    block = args.block or measure_rounds
-
-    # Warmup: compile the fused block step for every block size the
-    # measured loop will dispatch (the remainder block retraces).
-    trainer.run(rounds=block, block=block)
-    if measure_rounds % block:
-        # block > remainder keeps this on the blocked path (k=remainder),
-        # compiling the same trace the measured loop's last dispatch uses.
-        trainer.run(rounds=measure_rounds % block, block=block)
-
-    t0 = time.time()
-    trainer.run(rounds=measure_rounds, block=block)
-    elapsed = time.time() - t0
-    rounds_per_sec = measure_rounds / elapsed
-
+    fast_rps, fast_acc, fast_s = _measure(
+        _config(fast=True, train_size=train_size, test_size=test_size),
+        rounds, block)
     result = {
-        "metric": "gossip_rounds_per_sec_dsgd_mnist_6workers_model1",
-        "value": round(rounds_per_sec, 4),
+        "metric": "gossip_rounds_per_sec_dsgd_mnist_6workers_model1_bf16",
+        "value": round(fast_rps, 4),
         "unit": "rounds/sec",
-        "vs_baseline": round(rounds_per_sec / REFERENCE_ROUNDS_PER_SEC, 2),
+        "vs_baseline": round(fast_rps / REFERENCE_ROUNDS_PER_SEC, 2),
+        "fast_avg_test_acc": round(float(fast_acc), 4),
     }
+    if not args.skip_faithful:
+        f_rps, f_acc, f_s = _measure(
+            _config(fast=False, train_size=train_size, test_size=test_size),
+            rounds, block)
+        result["faithful_f32_rounds_per_sec"] = round(f_rps, 4)
+        result["faithful_f32_vs_baseline"] = round(
+            f_rps / REFERENCE_ROUNDS_PER_SEC, 2)
+        result["faithful_avg_test_acc"] = round(float(f_acc), 4)
+        print(f"# faithful f32: {rounds} rounds in {f_s:.2f}s "
+              f"(acc={f_acc:.4f})", file=sys.stderr)
+    print(f"# fast bf16: {rounds} rounds in {fast_s:.2f}s "
+          f"(acc={fast_acc:.4f})", file=sys.stderr)
     print(json.dumps(result))
-    # Context to stderr so stdout stays one JSON line.
-    last = trainer.history.last()
-    print(f"# {measure_rounds} rounds in {elapsed:.2f}s; "
-          f"last avg_test_acc={last.get('avg_test_acc')}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ def make_batch_plan(
     round_idx: int = 0,
     drop_last: bool = False,
     impl: str = "numpy",
+    workers: np.ndarray | None = None,
 ) -> BatchPlan:
     """Build the shuffled batch plan for one round.
 
@@ -62,18 +63,29 @@ def make_batch_plan(
     engine consume byte-identical batches — that determinism is what
     makes step-level numerics parity testable at all.
 
+    ``workers`` (optional [m] int array of worker ids) plans only those
+    workers' rows, returning an [m, S, B] plan bit-identical to the
+    matching rows of the full plan — the RNG is keyed by the TRUE worker
+    id, not the row position.  This keeps the compact-sampling fast path
+    O(m) on the host instead of O(W).
+
     ``impl='native'`` fills the plan with the C++ host runtime
     (``dopt.native``) — same contract and determinism key, different
     (xoshiro) RNG stream, so it is the throughput mode, not the
     oracle-parity mode; silently falls back to numpy when the native
     library is unavailable.
     """
+    worker_ids = None
+    if workers is not None:
+        worker_ids = np.asarray(workers, dtype=np.int64)
+        index_matrix = index_matrix[worker_ids]
     if impl == "native":
         from dopt.native import fill_batch_plan_native
 
         out = fill_batch_plan_native(
             index_matrix, batch_size=batch_size, local_ep=local_ep,
             seed=seed, round_idx=round_idx, drop_last=drop_last,
+            worker_ids=worker_ids,
         )
         if out is not None:
             return BatchPlan(idx=out[0], weight=out[1])
@@ -90,11 +102,12 @@ def make_batch_plan(
     idx = np.empty((w, s, bs), dtype=np.int32)
     weight = np.empty((w, s, bs), dtype=np.float32)
     for wi in range(w):
+        wid = int(worker_ids[wi]) if worker_ids is not None else wi
         rows_i = []
         mask_i = []
         for ep in range(local_ep):
             rng = np.random.default_rng(
-                np.random.SeedSequence([seed, round_idx, ep, wi])
+                np.random.SeedSequence([seed, round_idx, ep, wid])
             )
             perm = rng.permutation(l)
             if drop_last:

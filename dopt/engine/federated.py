@@ -196,6 +196,22 @@ class FederatedTrainer:
                 c_global, sub_new, sub_old,
             )
 
+        def finish(new_theta, new_p, new_m, new_duals, new_c, local_loss,
+                   train_x, train_y, ex, ey, ew, tidx, tweight):
+            """Shared round tail: global test eval + all-client train eval
+            (``avg_trainig_calculator``) — identical for both execution
+            paths so the history schema can never diverge between them."""
+            evalm = global_eval(new_theta, ex, ey, ew)
+            if eval_train_flag:
+                tx = train_x[tidx]
+                ty = train_y[tidx]
+                trainm = stacked_eval_perworker(new_p, tx, ty, tweight)
+            else:
+                trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
+                          "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
+            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
+                    evalm, trainm)
+
         def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
                      train_x, train_y, ex, ey, ew, tidx, tweight):
             bx = train_x[idx]
@@ -217,17 +233,10 @@ class FederatedTrainer:
             # reference's lifetime client optimizers.
             new_m = mom if algorithm == "scaffold" else _where_mask(mask, m_t, mom)
             new_theta = masked_average(new_p, mask)
-            evalm = global_eval(new_theta, ex, ey, ew)
-            if eval_train_flag:
-                tx = train_x[tidx]
-                ty = train_y[tidx]
-                trainm = stacked_eval_perworker(new_p, tx, ty, tweight)
-            else:
-                trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
-                          "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
-            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
-                    evalm, trainm)
+            return finish(new_theta, new_p, new_m, new_duals, new_c,
+                          local_loss, train_x, train_y, ex, ey, ew, tidx,
+                          tweight)
 
         # Per-worker train-split eval: every input has a worker axis.
         stacked_eval_perworker = jax.vmap(
@@ -267,17 +276,9 @@ class FederatedTrainer:
             new_p = _scatter(params, sel, p_t)
             new_m = mom if algorithm == "scaffold" else _scatter(mom, sel, m_t)
             new_theta = jax.tree.map(lambda x: x.mean(axis=0), p_t)
-            evalm = global_eval(new_theta, ex, ey, ew)
-            if eval_train_flag:
-                tx = train_x[tidx]
-                ty = train_y[tidx]
-                trainm = stacked_eval_perworker(new_p, tx, ty, tweight)
-            else:
-                trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
-                          "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
-            local_loss = losses.mean()
-            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
-                    evalm, trainm)
+            return finish(new_theta, new_p, new_m, new_duals, new_c,
+                          losses.mean(), train_x, train_y, ex, ey, ew, tidx,
+                          tweight)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
@@ -300,18 +301,20 @@ class FederatedTrainer:
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
-        m = max(int(frac * self.num_workers), 1)
-        if m >= self.num_workers:
-            return False
         if self.mesh.size > 1:
             # The compact path re-shapes the worker axis to m lanes and
             # never applies the mesh sharding — single-device only; on a
             # sharded mesh the N lanes are parallel hardware, so the
-            # full-width path is the right one anyway.
+            # full-width path is the right one anyway.  Checked before
+            # any frac-dependent early-out so an invalid config is
+            # rejected consistently, whatever frac this run uses.
             if f.compact:
                 raise ValueError(
                     "FederatedConfig.compact=True requires a single-device "
                     f"mesh (have {self.mesh.size} devices)")
+            return False
+        m = max(int(frac * self.num_workers), 1)
+        if m >= self.num_workers:
             return False
         if f.compact is not None:
             return f.compact
@@ -327,13 +330,17 @@ class FederatedTrainer:
             t = self.round
             with self.timers.phase("host_batch_plan"):
                 sel = self._sample_indices(frac)
+                # Compact path: plan only the m sampled workers' rows —
+                # host cost O(m), and the RNG is keyed by true worker id
+                # so the plans are bit-identical to the full plan's rows.
                 plan = make_batch_plan(
                     self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
+                    workers=sel if compact else None,
                 )
                 if compact:
-                    idx = jnp.asarray(plan.idx[sel])
-                    bweight = jnp.asarray(plan.weight[sel])
+                    idx = jnp.asarray(plan.idx)
+                    bweight = jnp.asarray(plan.weight)
                 else:
                     mask = np.zeros(self.num_workers, np.float32)
                     mask[sel] = 1.0

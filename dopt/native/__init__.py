@@ -24,7 +24,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "plan.cpp")
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 # ABI version in the filename: a cached .so from a different source
 # generation gets a different name, so a rebuild can never collide with
 # an already-dlopened stale handle (glibc returns the existing handle
@@ -75,6 +75,7 @@ def load_native() -> ctypes.CDLL | None:
                 ctypes.c_int64, ctypes.c_int64,  # local_ep, steps_per_epoch
                 ctypes.c_int32,                  # drop_last
                 ctypes.c_int64, ctypes.c_int64,  # seed, round_idx
+                ctypes.POINTER(ctypes.c_int64),  # worker_ids (nullable)
                 ctypes.POINTER(ctypes.c_int32),  # idx_out
                 ctypes.POINTER(ctypes.c_float),  # w_out
             ]
@@ -97,10 +98,16 @@ def fill_batch_plan_native(
     seed: int,
     round_idx: int,
     drop_last: bool = False,
+    worker_ids: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray] | None:
     """Native batch-plan fill; returns (idx, weight) arrays shaped like
     ``dopt.data.pipeline.make_batch_plan``'s, or None when the native
     library is unavailable (caller falls back to numpy).
+
+    ``worker_ids`` maps each row of ``index_matrix`` to its true worker
+    id for RNG keying (compact-sampling: pass the m sampled rows plus
+    their ids and get plans bit-identical to those rows of the full
+    plan).  None means row i is worker i.
 
     Deterministic in (seed, round_idx, epoch, worker) via a seeded
     xoshiro256** stream — NOT bit-identical to the numpy PCG64 plans
@@ -116,10 +123,17 @@ def fill_batch_plan_native(
     s = local_ep * steps_per_epoch
     idx = np.empty((w, s, bs), dtype=np.int32)
     weight = np.empty((w, s, bs), dtype=np.float32)
+    if worker_ids is not None:
+        wid = np.ascontiguousarray(worker_ids, dtype=np.int64)
+        if wid.shape != (w,):
+            raise ValueError(f"worker_ids shape {wid.shape} != ({w},)")
+        wid_ptr = wid.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    else:
+        wid_ptr = None
     rc = lib.dopt_fill_batch_plan(
         im.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         w, l, bs, local_ep, steps_per_epoch, int(drop_last),
-        seed, round_idx,
+        seed, round_idx, wid_ptr,
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         weight.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )

@@ -95,6 +95,11 @@ extern "C" {
 
 // Fill one round's plan.
 //   index_matrix : [num_workers, row_len] int32 per-worker dataset indices
+//   worker_ids   : nullable [num_workers] int64 — the TRUE worker id of each
+//                  row, used as the RNG key component.  Null means row i is
+//                  worker i.  Passing a subset of rows with their real ids
+//                  yields plans bit-identical to the matching rows of the
+//                  full-fleet plan (compact-sampling fast path).
 //   idx_out      : [num_workers, local_ep * steps_per_epoch, batch] int32
 //   w_out        : [num_workers, local_ep * steps_per_epoch, batch] float32
 // steps_per_epoch = ceil(row_len / batch) (drop_last=0) or
@@ -106,7 +111,8 @@ extern "C" {
 int dopt_fill_batch_plan(const int32_t *index_matrix, int64_t num_workers,
                          int64_t row_len, int64_t batch, int64_t local_ep,
                          int64_t steps_per_epoch, int32_t drop_last,
-                         int64_t seed, int64_t round_idx, int32_t *idx_out,
+                         int64_t seed, int64_t round_idx,
+                         const int64_t *worker_ids, int32_t *idx_out,
                          float *w_out) {
   if (!index_matrix || !idx_out || !w_out) return 1;
   if (num_workers <= 0 || row_len <= 0 || batch <= 0 || local_ep <= 0 ||
@@ -122,8 +128,9 @@ int dopt_fill_batch_plan(const int32_t *index_matrix, int64_t num_workers,
   int32_t *perm = new int32_t[row_len];
   for (int64_t wi = 0; wi < num_workers; ++wi) {
     const int32_t *row = index_matrix + wi * row_len;
+    const int64_t wid = worker_ids ? worker_ids[wi] : wi;
     for (int64_t ep = 0; ep < local_ep; ++ep) {
-      Xoshiro256ss rng(mix_key(seed, round_idx, ep, wi));
+      Xoshiro256ss rng(mix_key(seed, round_idx, ep, wid));
       std::memcpy(perm, row, sizeof(int32_t) * (size_t)row_len);
       // Fisher-Yates over the copied row.
       for (int64_t i = row_len - 1; i > 0; --i) {
@@ -150,6 +157,6 @@ int dopt_fill_batch_plan(const int32_t *index_matrix, int64_t num_workers,
 }
 
 // Library version tag so the Python side can detect stale cached builds.
-int dopt_native_abi_version() { return 1; }
+int dopt_native_abi_version() { return 2; }
 
 }  // extern "C"

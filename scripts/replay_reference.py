@@ -17,7 +17,9 @@ circle > star mixing for non-IID gossip — is what these artifacts
 demonstrate, plus the exact history schema.  Drop raw MNIST files under
 ``DOPT_DATA_DIR`` and re-run for real-data curves.
 
-Usage: python scripts/replay_reference.py [--smoke] [--out results]
+Usage: python scripts/replay_reference.py [--smoke] [--out DIR]
+(--smoke writes to results-smoke by default; the committed full-run
+artifacts in results/ are only touched by an explicit full run)
 """
 
 from __future__ import annotations
@@ -77,12 +79,15 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny data / few rounds (machinery check only)")
-    ap.add_argument("--out", default="results")
+    ap.add_argument("--out", default=None,
+                    help="output dir (default: results, or results-smoke "
+                         "under --smoke so a machinery check can never "
+                         "clobber the committed full-run artifacts)")
     ap.add_argument("--skip-federated", action="store_true")
     ap.add_argument("--skip-gossip", action="store_true")
     args = ap.parse_args()
 
-    out = Path(args.out)
+    out = Path(args.out or ("results-smoke" if args.smoke else "results"))
     out.mkdir(parents=True, exist_ok=True)
     scale = 0.02 if args.smoke else 1.0
     gossip_rounds = 2 if args.smoke else None

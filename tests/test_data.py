@@ -135,3 +135,16 @@ def test_finder_prefers_matching_dataset_dir(tmp_path):
     f2 = _Finder(tmp_path, prefer=("fashion", "fmnist"))
     hit2 = f2.find(["train-images-idx3-ubyte"])
     assert "FashionMNIST" in str(hit2)
+
+
+def test_batch_plan_worker_subset_matches_full_plan_rows():
+    """Compact-sampling planning: workers=[ids] must be bit-identical to
+    the matching rows of the full plan (RNG keyed by true worker id)."""
+    mat = np.arange(8 * 100, dtype=np.int64).reshape(8, 100)
+    full = make_batch_plan(mat, batch_size=32, local_ep=2, seed=7, round_idx=3)
+    sel = np.array([1, 4, 6])
+    sub = make_batch_plan(mat, batch_size=32, local_ep=2, seed=7, round_idx=3,
+                          workers=sel)
+    assert sub.idx.shape == (3, 8, 32)
+    np.testing.assert_array_equal(sub.idx, full.idx[sel])
+    np.testing.assert_array_equal(sub.weight, full.weight[sel])

@@ -77,3 +77,17 @@ def test_make_batch_plan_native_impl_dispatch():
     py = make_batch_plan(im, batch_size=8, local_ep=2, seed=3, round_idx=5)
     assert py.idx.shape == plan.idx.shape
     assert not np.array_equal(py.idx, plan.idx)
+
+
+def test_native_plan_worker_subset_matches_full_plan_rows():
+    if not native_available():
+        pytest.skip("native library unavailable")
+    mat = np.arange(8 * 100, dtype=np.int64).reshape(8, 100)
+    full = make_batch_plan(mat, batch_size=32, local_ep=2, seed=7,
+                           round_idx=3, impl="native")
+    sel = np.array([0, 3, 7])
+    sub = make_batch_plan(mat, batch_size=32, local_ep=2, seed=7,
+                          round_idx=3, impl="native", workers=sel)
+    assert sub.idx.shape == (3, 8, 32)
+    np.testing.assert_array_equal(sub.idx, full.idx[sel])
+    np.testing.assert_array_equal(sub.weight, full.weight[sel])
