@@ -78,6 +78,10 @@ class FederatedConfig:
     # training all N lanes and mask-discarding (the faithful wart).
     # None = auto (on for a single-device mesh when frac < 1); numerics
     # match the full-width path up to float summation order.
+    block_rounds: int = 1
+    # >1 fuses that many rounds into one lax.scan jit dispatch (same
+    # math, same per-round eval cadence) — the dispatch-overhead killer
+    # for small models; mirrors GossipConfig.block_rounds.
 
 
 @dataclass(frozen=True)

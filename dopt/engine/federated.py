@@ -68,6 +68,9 @@ class FederatedTrainer:
         f = cfg.federated
         if f.algorithm not in ("fedavg", "fedprox", "fedadmm", "scaffold"):
             raise ValueError(f"unknown federated algorithm {f.algorithm!r}")
+        from dopt.engine.gossip import _reject_sequence_model
+
+        _reject_sequence_model(cfg)
         self.cfg = cfg
         self.eval_train = eval_train
         self.round = 0
@@ -282,6 +285,33 @@ class FederatedTrainer:
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
+
+        def make_block_fn(one_round):
+            """k rounds fused into one lax.scan dispatch (jit retraces
+            per distinct k).  Each iteration is one full reference round
+            — sampled-client theta load, local epochs, masked average,
+            global + per-client train eval — so history rows are
+            identical to the per-round path's."""
+
+            def block_fn(theta, params, mom, duals, c_global, gates, idxs,
+                         bws, train_x, train_y, ex, ey, ew, tidx, tweight):
+                def body(carry, xs):
+                    th, p, m, d, c = carry
+                    gate, idx, bw = xs
+                    th, p, m, d, c, ll, evalm, trainm = one_round(
+                        th, p, m, d, c, gate, idx, bw,
+                        train_x, train_y, ex, ey, ew, tidx, tweight)
+                    return (th, p, m, d, c), (ll, evalm, trainm)
+
+                carry, (lls, evalms, trainms) = jax.lax.scan(
+                    body, (theta, params, mom, duals, c_global),
+                    (gates, idxs, bws))
+                return (*carry, lls, evalms, trainms)
+
+            return jax.jit(block_fn, donate_argnums=(1, 2, 3))
+
+        self._block_fn = make_block_fn(round_fn)
+        self._compact_block_fn = make_block_fn(compact_round_fn)
         self._global_eval = jax.jit(global_eval)
         self._sample_rng = host_rng(cfg.seed, 314159)
 
@@ -320,10 +350,89 @@ class FederatedTrainer:
             return f.compact
         return True
 
-    def run(self, frac: float | None = None, rounds: int | None = None) -> History:
+    def _run_blocked(self, frac: float, rounds: int, block: int) -> History:
+        """Run ``rounds`` rounds in fused blocks of up to ``block``."""
+        from dopt.parallel.mesh import worker_axes
+
+        cfg, f = self.cfg, self.cfg.federated
+        compact = self._use_compact(frac)
+        block_sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
+        )
+        t0 = time.time()
+        done = 0
+        while done < rounds:
+            k = min(block, rounds - done)
+            ts = [self.round + j for j in range(k)]
+            with self.timers.phase("host_batch_plan"):
+                sels = [self._sample_indices(frac) for _ in ts]
+                plans = [
+                    make_batch_plan(
+                        self.index_matrix, batch_size=f.local_bs,
+                        local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
+                        impl=cfg.data.plan_impl,
+                        workers=sel if compact else None,
+                    )
+                    for t, sel in zip(ts, sels)
+                ]
+                if compact:
+                    gates = jnp.asarray(np.stack(sels))
+                    idx = jnp.asarray(np.stack([p.idx for p in plans]))
+                    bw = jnp.asarray(np.stack([p.weight for p in plans]))
+                else:
+                    masks = np.zeros((k, self.num_workers), np.float32)
+                    for j, sel in enumerate(sels):
+                        masks[j, sel] = 1.0
+                    gates = jnp.asarray(masks)
+                    idx = jax.device_put(np.stack([p.idx for p in plans]),
+                                         block_sharding)
+                    bw = jax.device_put(np.stack([p.weight for p in plans]),
+                                        block_sharding)
+            duals_in = self.duals if self.duals is not None else {}
+            c_in = self.c_global if self.c_global is not None else {}
+            fn = self._compact_block_fn if compact else self._block_fn
+            (self.theta, self.params, self.momentum, new_duals, new_c, lls,
+             evalms, trainms) = self.timers.measure(
+                "round_step", fn,
+                self.theta, self.params, self.momentum, duals_in, c_in,
+                gates, idx, bw, self._train_x, self._train_y, *self._eval,
+                self._train_eval_idx, self._train_eval_w,
+            )
+            if self.duals is not None:
+                self.duals = new_duals
+            if self.c_global is not None:
+                self.c_global = new_c
+            lls = np.asarray(lls)
+            acc = np.asarray(evalms["acc"])
+            loss_sum = np.asarray(evalms["loss_sum"])
+            t_loss = np.asarray(trainms["loss_mean"])
+            t_acc = np.asarray(trainms["acc"])
+            for j, t in enumerate(ts):
+                self.history.append(
+                    round=t,
+                    test_acc=float(acc[j]),
+                    test_loss=float(loss_sum[j]),  # P1 summed-loss flavour
+                    train_loss=float(t_loss[j].mean()),
+                    train_acc=float(t_acc[j].mean()),
+                    local_loss=float(lls[j]),
+                )
+                self.round += 1
+            done += k
+        self.total_time = time.time() - t0
+        return self.history
+
+    def run(self, frac: float | None = None, rounds: int | None = None,
+            block: int | None = None) -> History:
+        """Train; ``block`` (default ``cfg.federated.block_rounds``) > 1
+        fuses that many rounds into one jit dispatch — same math, same
+        per-round eval cadence, same client-sampling sequence; only the
+        host/device round-trip count changes."""
         cfg, f = self.cfg, self.cfg.federated
         frac = f.frac if frac is None else frac
         rounds = f.rounds if rounds is None else rounds
+        block = f.block_rounds if block is None else block
+        if block > 1:
+            return self._run_blocked(frac, rounds, block)
         compact = self._use_compact(frac)
         t0 = time.time()
         for _ in range(rounds):

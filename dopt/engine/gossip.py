@@ -46,6 +46,20 @@ from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
 
 
+def _reject_sequence_model(cfg: ExperimentConfig) -> None:
+    """The federated/gossip engines drive image/feature datasets with
+    float inputs; sequence models need int32 token batches and a
+    sequence-parallel mesh — fail early with a pointer instead of an
+    obscure Embed dtype error deep inside model.init."""
+    if cfg.model.model.lower() == "transformer":
+        raise ValueError(
+            "model='transformer' is a sequence model and is not drivable by "
+            "the federated/gossip engines (their datasets are image/feature "
+            "tensors); build it via dopt.models.build_model and train with "
+            "dopt.parallel.sequence (ring/Ulysses attention) directly"
+        )
+
+
 def random_matching_matrix(n: int, rng: np.random.Generator) -> np.ndarray:
     """GossipLearning round matrix: a random perfect matching; matched
     pairs average (w=1/2 each), unmatched (odd n) keep their weights.
@@ -86,6 +100,7 @@ class GossipTrainer:
                 f"unknown gossip algorithm {g.algorithm!r}; one of "
                 "dsgd|nocons|centralized|fedlcon|gossip"
             )
+        _reject_sequence_model(cfg)
         if g.algorithm == "centralized":
             # The reference's Centeralized mutates the SHARED args object
             # (simulators.py:171-173) — we derive a new frozen config.

@@ -174,12 +174,75 @@ class ResNet18(nn.Module):
         return _head(x, self.faithful)
 
 
+class TransformerLM(nn.Module):
+    """Decoder-only transformer LM — the long-context member of the zoo.
+
+    Nothing like it exists in the reference (no attention, no sequence
+    axis anywhere — SURVEY §2.3); this is the framework's own
+    demonstration that its sequence-parallel substrate
+    (``dopt.parallel.sequence``) plugs into a real model.  ``attn_fn``
+    injects the attention implementation: ``None`` uses single-device
+    dense attention; pass ``lambda q,k,v: ring_attention(q,k,v,mesh,
+    causal=True)`` (or the Ulysses variant) to shard the sequence axis
+    over a mesh with NO other change to the model.
+
+    Pre-LN blocks, learned positional embeddings, weight-tied output
+    head.  Call input: [B, L] int32 tokens; output [B, L, vocab]
+    logits (``num_classes`` is the vocab size).
+    """
+
+    num_classes: int = 256          # vocab
+    faithful: bool = False          # kept for zoo-interface uniformity
+    dtype: Any = jnp.float32
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    max_len: int = 2048
+
+    @nn.compact
+    def __call__(self, tokens, attn_fn=None):
+        from dopt.parallel.sequence import dense_attention
+
+        attn = attn_fn or (lambda q, k, v: dense_attention(q, k, v,
+                                                           causal=True))
+        b, l = tokens.shape
+        if l > self.max_len:
+            raise ValueError(f"sequence length {l} > max_len {self.max_len}")
+        if self.dim % self.heads:
+            raise ValueError(f"dim {self.dim} not divisible by "
+                             f"heads {self.heads}")
+        emb = nn.Embed(self.num_classes, self.dim, dtype=self.dtype,
+                       name="tok_emb")
+        x = emb(tokens)
+        x = x + self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (self.max_len, self.dim))[None, :l].astype(self.dtype)
+        hd = self.dim // self.heads
+        for i in range(self.depth):
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(x)
+            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                           name=f"qkv_{i}")(y)
+            q, k, v = jnp.split(qkv.reshape(b, l, 3 * self.heads, hd), 3,
+                                axis=2)
+            o = attn(q, k, v).reshape(b, l, self.dim)
+            x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                             name=f"proj_{i}")(o)
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(x)
+            y = nn.Dense(4 * self.dim, dtype=self.dtype, name=f"up_{i}")(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(self.dim, dtype=self.dtype, name=f"down_{i}")(y)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = x @ emb.embedding.T.astype(self.dtype)
+        return _head(logits, self.faithful)
+
+
 _ZOO = {
     "model1": Model1,
     "model3": Model3,
     "mlp": MLP,
     "logistic": LogisticRegression,
     "resnet18": ResNet18,
+    "transformer": TransformerLM,
 }
 
 

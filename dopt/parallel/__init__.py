@@ -3,8 +3,14 @@ from dopt.parallel.mesh import (make_mesh, make_worker_mesh, shard_worker_tree,
                                 worker_sharding)
 from dopt.parallel.multihost import (dcn_edge_count, initialize_distributed,
                                      make_hybrid_mesh)
+from dopt.parallel.sequence import (dense_attention, make_seq_mesh,
+                                    ring_attention, ulysses_attention)
 
 __all__ = [
+    "dense_attention",
+    "make_seq_mesh",
+    "ring_attention",
+    "ulysses_attention",
     "make_mesh",
     "make_worker_mesh",
     "shard_worker_tree",

@@ -72,6 +72,33 @@ class History:
         return h
 
 
+def time_to_target(history: "History", *, target: float,
+                   key: str = "avg_test_acc",
+                   seconds_per_round: float | None = None) -> dict[str, Any]:
+    """The north-star meter (BASELINE.json): first round at which
+    ``key`` reaches ``target``, and — given a measured per-round
+    wall-clock — the implied time-to-target.
+
+    Returns {reached, round, rounds, seconds} where ``round`` is the
+    history row's round number, ``rounds`` counts rows up to and
+    including it, and ``seconds`` is rounds * seconds_per_round (None
+    when no rate is supplied).  Rows without ``key`` (eval-skipped
+    rounds) are passed over.
+    """
+    for i, row in enumerate(history.rows):
+        v = row.get(key)
+        if v is not None and v >= target:
+            rounds = i + 1
+            return {
+                "reached": True,
+                "round": row.get("round", i),
+                "rounds": rounds,
+                "seconds": (None if seconds_per_round is None
+                            else rounds * seconds_per_round),
+            }
+    return {"reached": False, "round": None, "rounds": None, "seconds": None}
+
+
 def _scalar(v: Any) -> Any:
     """Unwrap 0-d arrays / jax scalars so rows are plain JSON-able."""
     try:

@@ -18,7 +18,9 @@ For each preset (baseline1..baseline5):
     bounds).
 
 Writes results to --out (default results/bench_suite.json) and prints
-one summary line per config.
+one summary line per config.  Run on an otherwise-idle machine: the
+oracle numbers are host-CPU timings and concurrent load inflates them
+(which would overstate the reported speedups).
 
 Usage: python scripts/bench_suite.py [--quick] [--only baseline2 ...]
 """
@@ -124,11 +126,11 @@ def _torch_resnet18(in_ch: int = 3, num_classes: int = 10):
 
 
 def oracle_round_seconds(cfg, index_matrix, dataset, *, local_ep, local_bs,
-                         workers_per_round, max_steps=None) -> float:
+                         workers_per_round,
+                         max_steps=None) -> tuple[float, int, int]:
     """Time ONE worker's local round with torch on CPU and extrapolate to
-    the sequential cost of all ``workers_per_round`` workers."""
-    import torch
-
+    the sequential cost of all ``workers_per_round`` workers.  Returns
+    (seconds, steps actually timed, steps per worker round)."""
     from dopt.data import make_batch_plan
     from dopt.engine.oracle import OracleWorker
 
@@ -138,21 +140,26 @@ def oracle_round_seconds(cfg, index_matrix, dataset, *, local_ep, local_bs,
                            local_ep=local_ep, seed=cfg.seed, round_idx=0,
                            workers=np.array([0]))
     idx, weight = plan.idx[0], plan.weight[0]
+    steps_timed = idx.shape[0]
     if max_steps is not None and idx.shape[0] > max_steps:
         idx, weight = idx[:max_steps], weight[:max_steps]
+        steps_timed = max_steps
     bx = dataset.train_x[idx]
     if bx.ndim == 5:  # [S,B,H,W,C] image batches -> torch [S,B,C,H,W]
         bx = np.ascontiguousarray(np.transpose(bx, (0, 1, 4, 2, 3)))
     by = dataset.train_y[idx]
     steps_total = plan.idx.shape[1]
 
-    with torch.no_grad():  # warmup allocations / autotuning
-        model(torch.from_numpy(np.ascontiguousarray(bx[0])))
+    # Warm up the TRAINING path (autograd graph construction, SGD
+    # momentum-buffer allocation) so the timed window measures
+    # steady-state steps — otherwise per_step is biased high and the
+    # "speedups are lower bounds" guarantee breaks.
+    worker.local_update(bx[:1], by[:1], weight[:1])
     t0 = time.perf_counter()
     worker.local_update(bx, by, weight)
     elapsed = time.perf_counter() - t0
     per_step = elapsed / idx.shape[0]
-    return per_step * steps_total * workers_per_round
+    return per_step * steps_total * workers_per_round, steps_timed, steps_total
 
 
 # ---------------------------------------------------------------------
@@ -175,7 +182,7 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
     rounds = 3 if quick else (5 if cfg.model.model == "resnet18" else 10)
 
     trainer = (GossipTrainer if is_gossip else FederatedTrainer)(cfg)
-    run_kwargs = {"block": rounds} if is_gossip else {}
+    run_kwargs = {"block": rounds}
     trainer.run(rounds=rounds, **run_kwargs)           # compile + warmup
     t0 = time.perf_counter()
     trainer.run(rounds=rounds, **run_kwargs)
@@ -203,13 +210,18 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         "compute_dtype": "bfloat16",
     }
     if not skip_oracle:
-        max_steps = 4 if cfg.model.model == "resnet18" else (8 if quick else None)
-        oracle_s = oracle_round_seconds(
+        max_steps = 8 if (quick or cfg.model.model == "resnet18") else None
+        oracle_s, steps_timed, steps_total = oracle_round_seconds(
             cfg, trainer.index_matrix, trainer.dataset,
             local_ep=g.local_ep, local_bs=g.local_bs,
             workers_per_round=workers_per_round, max_steps=max_steps)
         out["oracle_round_sec_extrapolated"] = round(oracle_s, 3)
         out["oracle_rounds_per_sec"] = round(1.0 / oracle_s, 5)
+        # Provenance of the extrapolation: per-step time measured over
+        # steps_timed of the round's steps_total steps, one worker,
+        # then scaled linearly (sequential execution is linear).
+        out["oracle_steps_timed"] = steps_timed
+        out["oracle_steps_per_worker_round"] = steps_total
         out["speedup_vs_sequential_torch_cpu"] = round(oracle_s * rps, 1)
     return out
 

@@ -139,3 +139,17 @@ def test_timers_populated_by_run(devices):
     s = tr.timers.summary()
     assert s["round_step"]["count"] == 2
     assert s["host_batch_plan"]["count"] == 2
+
+
+def test_time_to_target():
+    from dopt.utils.metrics import History, time_to_target
+
+    h = History("t")
+    h.append(round=0, avg_test_acc=0.2)
+    h.append(round=1)                      # eval-skipped row
+    h.append(round=2, avg_test_acc=0.85)
+    h.append(round=3, avg_test_acc=0.95)
+    hit = time_to_target(h, target=0.9, seconds_per_round=2.0)
+    assert hit == {"reached": True, "round": 3, "rounds": 4, "seconds": 8.0}
+    miss = time_to_target(h, target=0.99)
+    assert miss["reached"] is False and miss["seconds"] is None

@@ -293,3 +293,66 @@ def test_compact_sampling_matches_full_width(devices, algorithm):
                                        atol=2e-5, rtol=1e-4)
     np.testing.assert_allclose(a.history["test_acc"], b.history["test_acc"],
                                atol=1e-3)
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedadmm", "scaffold"])
+def test_federated_blocked_matches_per_round(devices, algorithm):
+    # The fused multi-round block path (lax.scan over rounds in one jit)
+    # must reproduce the per-round path exactly: same client-sampling
+    # sequence, same history rows, same final state.  Covers both the
+    # full-width (sharded mesh) and compact (single-device) paths via
+    # the default mesh.
+    import jax
+
+    def run(block):
+        tr = FederatedTrainer(_fed_cfg(algorithm))
+        tr.run(rounds=4, block=block)
+        return tr
+
+    a = run(1)
+    b = run(2)
+    c = run(3)  # remainder block: 3 + 1
+    for other in (b, c):
+        for x, y in zip(jax.tree.leaves(jax.device_get(a.theta)),
+                        jax.tree.leaves(jax.device_get(other.theta))):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-6, rtol=1e-5)
+        np.testing.assert_allclose(a.history["test_acc"],
+                                   other.history["test_acc"], atol=1e-5)
+        np.testing.assert_allclose(a.history["train_loss"],
+                                   other.history["train_loss"], atol=1e-5)
+        np.testing.assert_allclose(a.history["local_loss"],
+                                   other.history["local_loss"], atol=1e-5)
+
+
+def test_federated_blocked_compact_single_device(devices):
+    # Compact + blocked on one device: sel gates are [k, m] index arrays.
+    import jax
+
+    def run(block):
+        cfg = _fed_cfg("fedavg")
+        cfg = cfg.replace(federated=dataclasses.replace(
+            cfg.federated, compact=True), mesh_devices=1)
+        tr = FederatedTrainer(cfg)
+        tr.run(rounds=4, block=block)
+        return tr
+
+    a = run(1)
+    b = run(4)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.theta)),
+                    jax.tree.leaves(jax.device_get(b.theta))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(a.history["test_acc"],
+                               b.history["test_acc"], atol=1e-5)
+
+
+def test_engines_reject_transformer_model(devices):
+    cfg = _gossip_cfg()
+    cfg = cfg.replace(model=dataclasses.replace(cfg.model, model="transformer"))
+    with pytest.raises(ValueError, match="sequence model"):
+        GossipTrainer(cfg)
+    fcfg = _fed_cfg()
+    fcfg = fcfg.replace(model=dataclasses.replace(fcfg.model, model="transformer"))
+    with pytest.raises(ValueError, match="sequence model"):
+        FederatedTrainer(fcfg)
