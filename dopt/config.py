@@ -103,6 +103,12 @@ class GossipConfig:
     # stale new_weights accumulation, simulators.py:189-196) for oracle
     # comparison; the idiomatic path fixes them.
     self_weight: bool = False   # reference mixing has zero diagonal (SURVEY §6.2)
+    comm_dtype: str | None = None
+    # Communication compression for the consensus collective: e.g.
+    # "bfloat16" narrows model shards BEFORE the cross-worker
+    # contraction/ppermute, halving ICI/DCN bytes per gossip round;
+    # params and local compute stay at their own dtype.  None =
+    # communicate at the compute dtype.
     dropout: float = 0.0
     # Fault injection: per-round probability each worker is down.  Down
     # workers skip consensus AND local training for the round; the mixing

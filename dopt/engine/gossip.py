@@ -176,6 +176,7 @@ class GossipTrainer:
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
         mesh = self.mesh
+        comm_dtype = jnp.dtype(g.comm_dtype) if g.comm_dtype else None
 
         def zeros_eval():
             z = jnp.zeros(self.num_workers)
@@ -192,7 +193,8 @@ class GossipTrainer:
         def round_fn(params, mom, w_matrix, alive, idx, bweight,
                      train_x, train_y, ex, ey, ew, do_eval):
             if do_mix:
-                params = mix_power(params, w_matrix, eps=eps, mesh=mesh)
+                params = mix_power(params, w_matrix, eps=eps, mesh=mesh,
+                                   comm_dtype=comm_dtype)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
@@ -235,7 +237,8 @@ class GossipTrainer:
                 p, m = carry
                 w_t, alive_t, idx_t, bw_t, ev_t = xs
                 if do_mix:
-                    p = mix_power(p, w_t, eps=eps, mesh=mesh)
+                    p = mix_power(p, w_t, eps=eps, mesh=mesh,
+                                  comm_dtype=comm_dtype)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t,
                                                  train_x, train_y)

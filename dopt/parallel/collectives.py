@@ -35,15 +35,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dopt.parallel.mesh import WORKER_AXIS
 
 
-def mix_dense(stacked, w_matrix, mesh: Mesh | None = None):
+def mix_dense(stacked, w_matrix, mesh: Mesh | None = None,
+              comm_dtype=None):
     """x_i ← Σ_j W_ij x_j for every leaf of a stacked [W, ...] pytree.
 
     Global-view formulation; XLA inserts the collectives when the worker
     axis is sharded.  ``w_matrix`` may be [n, n] or a scalar-weighted
     stack already selected for the round.  Pass ``mesh`` to pin the
     output back onto the worker axis (XLA otherwise may choose to
-    replicate the contraction result)."""
+    replicate the contraction result).
+
+    ``comm_dtype`` (e.g. ``jnp.bfloat16``) is WIRE-ONLY compression:
+    shards are narrowed just for the cross-device gather (halving
+    ICI/DCN bytes at bf16) and everything else stays exact — the mixing
+    matrix remains float32 (bf16 would break row-stochasticity by
+    ~1e-3/row and compound over rounds) and the accumulation runs in
+    float32.  Requires ``mesh`` (without a mesh nothing crosses a wire,
+    so there is nothing to compress — it raises to avoid a silent
+    no-op)."""
     w = jnp.asarray(w_matrix, dtype=jnp.float32)
+    if comm_dtype is not None:
+        if mesh is None:
+            raise ValueError("comm_dtype compression requires a mesh")
+        return _mix_dense_compressed(stacked, w, mesh, comm_dtype)
 
     def mix_leaf(x):
         y = jnp.tensordot(w.astype(x.dtype), x, axes=[[1], [0]])
@@ -57,7 +71,32 @@ def mix_dense(stacked, w_matrix, mesh: Mesh | None = None):
     return jax.tree.map(mix_leaf, stacked)
 
 
-def mix_shifts_shardmap(stacked, shifts, mesh: Mesh):
+def _mix_dense_compressed(stacked, w, mesh: Mesh, comm_dtype):
+    """Wire-only compressed dense mixing as an explicit shard_map: each
+    device all-gathers the OTHER workers' shards at ``comm_dtype`` (the
+    only bytes that cross ICI/DCN), then contracts its f32 mixing-matrix
+    rows against the f32-upcast gather — exact W, f32 accumulation,
+    narrow wire."""
+    from dopt.parallel.mesh import worker_axes
+
+    ax = worker_axes(mesh)
+
+    def per_device(wr, xl):
+        # wr: [W/D, W] f32 rows; xl: [W/D, ...] local worker shard.
+        xg = jax.lax.all_gather(xl.astype(comm_dtype), ax, axis=0,
+                                tiled=True)
+        y = jnp.tensordot(wr, xg.astype(jnp.float32), axes=[[1], [0]])
+        return y.astype(xl.dtype)
+
+    def mix_leaf(x):
+        fn = jax.shard_map(per_device, mesh=mesh,
+                           in_specs=(P(ax, None), P(ax)), out_specs=P(ax))
+        return fn(w, x)
+
+    return jax.tree.map(mix_leaf, stacked)
+
+
+def mix_shifts_shardmap(stacked, shifts, mesh: Mesh, comm_dtype=None):
     """Explicit ICI path: x_i ← Σ_s coeff_s[i] · x_{(i+s) mod n}.
 
     ``shifts`` is ``[(shift, coeffs[n]), ...]`` from
@@ -74,6 +113,10 @@ def mix_shifts_shardmap(stacked, shifts, mesh: Mesh):
 
     def per_device(coeffs, x):
         # x: [1, ...] local worker shard; coeffs: [k, 1] this worker's weights
+        # comm_dtype narrows the shard only for the ppermute hops (the
+        # bytes on the wire); the shift-0 self term never crosses a wire
+        # and stays exact, and accumulation stays at the leaf dtype.
+        xc = x.astype(comm_dtype) if comm_dtype is not None else x
         acc = jnp.zeros_like(x)
         for k, s in enumerate(shift_ids):
             if s == 0:
@@ -82,8 +125,8 @@ def mix_shifts_shardmap(stacked, shifts, mesh: Mesh):
                 # worker i needs x_{(i+s) mod n}: the shard travels from
                 # device (d+s) mod n to device d.
                 perm = [((d + s) % n, d) for d in range(n)]
-                contrib = jax.lax.ppermute(x, WORKER_AXIS, perm)
-            acc = acc + coeffs[k].astype(x.dtype) * contrib
+                contrib = jax.lax.ppermute(xc, WORKER_AXIS, perm)
+            acc = acc + coeffs[k].astype(x.dtype) * contrib.astype(x.dtype)
         return acc
 
     coeff_specs = P(None, WORKER_AXIS)  # [k, n] -> coeffs sharded on worker axis
@@ -134,15 +177,16 @@ def broadcast_to_workers(tree, num_workers: int):
     )
 
 
-def mix_power(stacked, w_matrix, eps: int = 1, mesh: Mesh | None = None):
+def mix_power(stacked, w_matrix, eps: int = 1, mesh: Mesh | None = None,
+              comm_dtype=None):
     """eps consensus sweeps (FedLCon, simulators.py:182-212 — with the
     stale-accumulation bug fixed: each sweep reads the previous sweep's
     output).  eps=1 is plain consensus; jit at the caller."""
     if eps == 1:
-        return mix_dense(stacked, w_matrix, mesh)
+        return mix_dense(stacked, w_matrix, mesh, comm_dtype)
 
     def body(x, _):
-        return mix_dense(x, w_matrix, mesh), None
+        return mix_dense(x, w_matrix, mesh, comm_dtype), None
 
     out, _ = jax.lax.scan(body, stacked, None, length=eps)
     return out

@@ -36,6 +36,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="restore this checkpoint before running")
     ap.add_argument("--timers", action="store_true",
                     help="print phase-timer report")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="capture a jax/XLA profiler trace of the run "
+                         "into DIR (view with tensorboard or xprof)")
     args = ap.parse_args(argv)
 
     from dopt.presets import PRESETS, get_preset
@@ -73,7 +76,14 @@ def main(argv: list[str] | None = None) -> int:
     if rounds is None:
         rounds = (cfg.federated.rounds if cfg.federated is not None
                   else cfg.gossip.rounds)
-    trainer.run(rounds=rounds)
+    if args.trace:
+        from dopt.utils.profiling import trace
+
+        with trace(args.trace):
+            trainer.run(rounds=rounds)
+        print(f"wrote XLA trace to {args.trace}", file=sys.stderr)
+    else:
+        trainer.run(rounds=rounds)
     for row in trainer.history.rows[-min(rounds, len(trainer.history)):]:
         print(json.dumps(row))
     print(f"total_time_s={trainer.total_time:.2f}", file=sys.stderr)

@@ -105,3 +105,52 @@ def test_mix_power_applies_eps_sweeps(devices):
     want = _np_mix(w3, tree)
     for k in tree:
         np.testing.assert_allclose(np.asarray(out[k]), want[k], rtol=2e-4, atol=1e-5)
+
+
+def test_mix_dense_comm_compression_bf16(devices):
+    # bf16 on-the-wire mixing approximates the f32 result within bf16
+    # tolerance and preserves the leaf dtype.
+    mesh = make_mesh(8)
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    tree = shard_worker_tree(_tree(8), mesh)
+    exact = mix_dense(tree, mm.matrices[0], mesh)
+    comp = mix_dense(tree, mm.matrices[0], mesh, comm_dtype=jnp.bfloat16)
+    for k in tree:
+        assert comp[k].dtype == tree[k].dtype
+        np.testing.assert_allclose(np.asarray(comp[k]), np.asarray(exact[k]),
+                                   atol=0.03, rtol=0.03)
+
+
+def test_mix_shifts_comm_compression_bf16(devices):
+    mesh = make_mesh(8)
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    shifts = shift_decomposition(mm.matrices[0])
+    tree = shard_worker_tree(_tree(8), mesh)
+    exact = mix_shifts_shardmap(tree, shifts, mesh)
+    comp = mix_shifts_shardmap(tree, shifts, mesh, comm_dtype=jnp.bfloat16)
+    for k in tree:
+        assert comp[k].dtype == tree[k].dtype
+        np.testing.assert_allclose(np.asarray(comp[k]), np.asarray(exact[k]),
+                                   atol=0.03, rtol=0.03)
+
+
+def test_mix_dense_comm_compression_hybrid_mesh(devices):
+    # Wire-only compression must also work on the 2-D (hosts x ici)
+    # hybrid mesh — the all_gather runs over the worker-axis tuple.
+    from dopt.parallel.multihost import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(2)
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    tree = shard_worker_tree(_tree(8), mesh)
+    exact = mix_dense(tree, mm.matrices[0], mesh)
+    comp = mix_dense(tree, mm.matrices[0], mesh, comm_dtype=jnp.bfloat16)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(comp[k]), np.asarray(exact[k]),
+                                   atol=0.02, rtol=0.02)
+
+
+def test_mix_dense_comm_compression_requires_mesh(devices):
+    tree = _tree(8)
+    with pytest.raises(ValueError, match="requires a mesh"):
+        mix_dense(tree, np.eye(8, dtype=np.float32), None,
+                  comm_dtype=jnp.bfloat16)

@@ -356,3 +356,14 @@ def test_engines_reject_transformer_model(devices):
     fcfg = fcfg.replace(model=dataclasses.replace(fcfg.model, model="transformer"))
     with pytest.raises(ValueError, match="sequence model"):
         FederatedTrainer(fcfg)
+
+
+def test_gossip_comm_compression_trains(devices):
+    # bf16 on-the-wire consensus: the run proceeds and the consensus
+    # still contracts disagreement (approximate mixing is still mixing).
+    cfg = _gossip_cfg(gossip=dict(comm_dtype="bfloat16", rounds=3))
+    tr = GossipTrainer(cfg)
+    h = tr.run()
+    assert len(h) == 3
+    ref = GossipTrainer(_gossip_cfg()).run()
+    assert abs(h.last()["avg_test_acc"] - ref.last()["avg_test_acc"]) < 0.1
