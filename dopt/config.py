@@ -103,6 +103,13 @@ class GossipConfig:
     # stale new_weights accumulation, simulators.py:189-196) for oracle
     # comparison; the idiomatic path fixes them.
     self_weight: bool = False   # reference mixing has zero diagonal (SURVEY §6.2)
+    choco_gamma: float = 1.0    # CHOCO-SGD consensus step size γ
+    compression: str = "topk"   # CHOCO compressor: topk | randk | none
+    compression_ratio: float = 1.0  # fraction of entries communicated
+    # algorithm='choco' (Koloskova et al. 2019): workers gossip a
+    # COMPRESSED difference Q(x_i − x̂_i) with error feedback, then take
+    # the consensus step x_i += γ·((W x̂)_i − x̂_i).  ratio=1 with γ=1
+    # reduces exactly to D-SGD (tested).
     comm_dtype: str | None = None
     # Communication compression for the consensus collective: e.g.
     # "bfloat16" narrows model shards BEFORE the cross-worker

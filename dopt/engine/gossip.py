@@ -35,8 +35,8 @@ from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update,
                                make_stacked_local_update_gather)
 from dopt.models import build_model, count_params
-from dopt.parallel.collectives import (broadcast_to_workers, mix_power,
-                                       where_mask)
+from dopt.parallel.collectives import (broadcast_to_workers, mix_dense,
+                                       mix_power, where_mask)
 from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
                                 worker_axes, worker_sharding)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
@@ -89,16 +89,21 @@ class GossipTrainer:
                       cfg.gossip.faithful_bugs=True reproduces the
                       effectively-one-sweep behaviour)
       'gossip'      — random pairwise matching per round (the stub, implemented)
+      'choco'       — CHOCO-SGD (Koloskova et al. 2019): compressed-difference
+                      gossip Q(x_i − x̂_i) with error feedback; consensus step
+                      x_i += γ·((W x̂)_i − x̂_i).  Beyond the reference —
+                      communication-efficient decentralized training.
     """
 
     def __init__(self, cfg: ExperimentConfig, *, eval_every: int = 1):
         if cfg.gossip is None:
             raise ValueError("cfg.gossip must be set for GossipTrainer")
         g = cfg.gossip
-        if g.algorithm not in ("dsgd", "nocons", "centralized", "fedlcon", "gossip"):
+        if g.algorithm not in ("dsgd", "nocons", "centralized", "fedlcon",
+                               "gossip", "choco"):
             raise ValueError(
                 f"unknown gossip algorithm {g.algorithm!r}; one of "
-                "dsgd|nocons|centralized|fedlcon|gossip"
+                "dsgd|nocons|centralized|fedlcon|gossip|choco"
             )
         _reject_sequence_model(cfg)
         if g.algorithm == "centralized":
@@ -150,9 +155,16 @@ class GossipTrainer:
         self.momentum = shard_worker_tree(
             jax.tree.map(np.zeros_like, jax.device_get(stacked)), self.mesh
         )
+        # CHOCO-SGD "public copy" state x̂ (what the fleet believes each
+        # worker's params are, updated only by compressed q exchanges).
+        self.x_hat = (
+            shard_worker_tree(
+                jax.tree.map(np.zeros_like, jax.device_get(stacked)), self.mesh)
+            if g.algorithm == "choco" else {}
+        )
 
         # Mixing schedule (matrices are data).
-        if g.algorithm in ("dsgd", "fedlcon"):
+        if g.algorithm in ("dsgd", "fedlcon", "choco"):
             self.mixing: MixingMatrices | None = build_mixing_matrices(
                 g.topology, g.mode, w, seed=cfg.seed, self_weight=g.self_weight,
             )
@@ -175,8 +187,35 @@ class GossipTrainer:
         evaluator = make_stacked_evaluator(self.model.apply)
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
+        is_choco = g.algorithm == "choco"
         mesh = self.mesh
         comm_dtype = jnp.dtype(g.comm_dtype) if g.comm_dtype else None
+
+        if is_choco:
+            from dopt.ops.compression import make_compressor
+
+            compressor = make_compressor(g.compression, g.compression_ratio)
+            choco_gamma = g.choco_gamma
+            choco_key = jax.random.key(cfg.seed ^ 0x0C0C0)
+
+        def choco_mix(params, x_hat, w_matrix, alive, t):
+            """One CHOCO-SGD gossip exchange (Koloskova et al. 2019).
+            Communication object: q = Q(x_i − x̂_i) only (error feedback
+            lives in the uncommunicated residual); every worker then
+            advances the shared public-copy table and takes the
+            consensus step  x_i += γ·((W x̂)_i − x̂_i)."""
+            key = jax.random.fold_in(choco_key, t)
+            diff = jax.tree.map(lambda a, b: a - b, params, x_hat)
+            q = compressor(diff, key)
+            if has_dropout:
+                # Dead workers send nothing: their public copy freezes.
+                q = where_mask(alive, q, jax.tree.map(jnp.zeros_like, q))
+            x_hat = jax.tree.map(lambda a, b: a + b, x_hat, q)
+            mixed = mix_dense(x_hat, w_matrix, mesh, comm_dtype)
+            new_p = jax.tree.map(
+                lambda p, mx, xh: p + (choco_gamma * (mx - xh)).astype(p.dtype),
+                params, mixed, x_hat)
+            return new_p, x_hat
 
         def zeros_eval():
             z = jnp.zeros(self.num_workers)
@@ -190,9 +229,11 @@ class GossipTrainer:
             return ((losses.mean(axis=1) * alive).sum() / denom,
                     (accs.mean(axis=1) * alive).sum() / denom)
 
-        def round_fn(params, mom, w_matrix, alive, idx, bweight,
+        def round_fn(params, mom, x_hat, w_matrix, alive, t, idx, bweight,
                      train_x, train_y, ex, ey, ew, do_eval):
-            if do_mix:
+            if is_choco:
+                params, x_hat = choco_mix(params, x_hat, w_matrix, alive, t)
+            elif do_mix:
                 params = mix_power(params, w_matrix, eps=eps, mesh=mesh,
                                    comm_dtype=comm_dtype)
             evalm = jax.lax.cond(
@@ -209,9 +250,9 @@ class GossipTrainer:
                 p_t = where_mask(alive, p_t, params)
                 m_t = where_mask(alive, m_t, mom)
             tl, ta = train_metrics(losses, accs, alive)
-            return p_t, m_t, tl, ta, evalm
+            return p_t, m_t, x_hat, tl, ta, evalm
 
-        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1))
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
 
         # Fused multi-round block path (lax.scan over rounds in ONE jit).
@@ -223,7 +264,7 @@ class GossipTrainer:
         )
         local_g, ev = self._local_gather, self._evaluator
 
-        def block_fn(params, mom, w_mats, alive, idx, bw, is_eval,
+        def block_fn(params, mom, x_hat, w_mats, alive, ts, idx, bw, is_eval,
                      train_x, train_y, ex, ey, ew):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
@@ -234,9 +275,11 @@ class GossipTrainer:
             resident train arrays; compile cost is O(1) in k."""
 
             def body(carry, xs):
-                p, m = carry
-                w_t, alive_t, idx_t, bw_t, ev_t = xs
-                if do_mix:
+                p, m, xh = carry
+                w_t, alive_t, t_t, idx_t, bw_t, ev_t = xs
+                if is_choco:
+                    p, xh = choco_mix(p, xh, w_t, alive_t, t_t)
+                elif do_mix:
                     p = mix_power(p, w_t, eps=eps, mesh=mesh,
                                   comm_dtype=comm_dtype)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
@@ -246,14 +289,15 @@ class GossipTrainer:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                return (p_t, m_t), (tl, ta, evalm)
+                return (p_t, m_t, xh), (tl, ta, evalm)
 
-            (params, mom), (tl, ta, evalms) = jax.lax.scan(
-                body, (params, mom), (w_mats, alive, idx, bw, is_eval)
+            (params, mom, x_hat), (tl, ta, evalms) = jax.lax.scan(
+                body, (params, mom, x_hat), (w_mats, alive, ts, idx, bw,
+                                             is_eval)
             )
-            return params, mom, tl, ta, evalms
+            return params, mom, x_hat, tl, ta, evalms
 
-        self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1))
+        self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
 
     def _run_blocked(self, rounds: int, block: int) -> History:
         """Run ``rounds`` rounds in fused blocks of up to ``block``."""
@@ -283,9 +327,11 @@ class GossipTrainer:
             is_eval = np.asarray(
                 [(t % self.eval_every) == 0 for t in ts], dtype=bool
             )
-            self.params, self.momentum, tl, ta, evalms = self.timers.measure(
+            (self.params, self.momentum, self.x_hat, tl, ta,
+             evalms) = self.timers.measure(
                 "round_step", self._block_fn,
-                self.params, self.momentum, w_mats, alive, idx, bw,
+                self.params, self.momentum, self.x_hat, w_mats, alive,
+                jnp.asarray(ts, jnp.int32), idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
                 *self._eval,
             )
@@ -362,12 +408,12 @@ class GossipTrainer:
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
-            self.params, self.momentum, train_loss, train_acc, evalm = (
-                self.timers.measure(
-                    "round_step", self._round_fn,
-                    self.params, self.momentum, w_t, alive, idx, bweight,
-                    self._train_x, self._train_y, *self._eval, do_eval,
-                )
+            (self.params, self.momentum, self.x_hat, train_loss, train_acc,
+             evalm) = self.timers.measure(
+                "round_step", self._round_fn,
+                self.params, self.momentum, self.x_hat, w_t, alive,
+                jnp.asarray(t, jnp.int32), idx, bweight,
+                self._train_x, self._train_y, *self._eval, do_eval,
             )
             row = {
                 "round": t,
@@ -389,9 +435,12 @@ class GossipTrainer:
         resumed 'gossip' run must not replay round-0 matchings)."""
         from dopt.utils.checkpoint import save_checkpoint
 
+        arrays = {"params": self.params, "momentum": self.momentum}
+        if self.cfg.gossip.algorithm == "choco":
+            arrays["x_hat"] = self.x_hat
         save_checkpoint(
             path,
-            arrays={"params": self.params, "momentum": self.momentum},
+            arrays=arrays,
             meta={"round": self.round, "name": self.cfg.name,
                   "algorithm": self.cfg.gossip.algorithm,
                   "history": self.history.rows,
@@ -411,6 +460,12 @@ class GossipTrainer:
             )
         self.params = shard_worker_tree(arrays["params"], self.mesh)
         self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
+        if self.cfg.gossip.algorithm == "choco":
+            if "x_hat" not in arrays:
+                raise ValueError(
+                    "choco trainer requires its public-copy state "
+                    "('x_hat') in the checkpoint")
+            self.x_hat = shard_worker_tree(arrays["x_hat"], self.mesh)
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
         if meta.get("matching_rng_state"):

@@ -1,0 +1,90 @@
+"""Compression operators for communication-efficient gossip (CHOCO-SGD).
+
+The reference has no notion of communication cost at all (its "network"
+is Python object passing — SURVEY §2.4); these operators exist for the
+framework's own communication-efficient algorithms
+(``GossipConfig.algorithm='choco'``): each worker communicates a
+compressed *difference* ``Q(x_i − x̂_i)`` instead of full parameters,
+with the error kept in ``x_i − x̂_i`` and fed back next round (error
+feedback is what makes aggressive compression convergent).
+
+All operators are pure, shape-static (XLA-friendly: ``top_k`` with a
+compile-time k, seeded masks instead of data-dependent sparsity), and
+act per worker on stacked [W, ...] pytrees.
+
+Contract: an operator maps (tree, key) → tree of the same structure
+where each worker's leaf slice retains ``ratio`` of its mass per the
+operator's rule and the rest is zero.  ``ratio=1.0`` must be the exact
+identity — that invariant is what the choco≡dsgd reduction test pins.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _per_worker_topk(flat: jnp.ndarray, k: int) -> jnp.ndarray:
+    """flat: [W, N] — keep the k largest-|·| entries per row."""
+    n = flat.shape[1]
+    if k >= n:
+        return flat
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)          # [W, k]
+    mask = jnp.zeros_like(flat).at[
+        jnp.arange(flat.shape[0])[:, None], idx].set(1.0)
+    return flat * mask
+
+
+def top_k_compress(tree, ratio: float):
+    """Magnitude top-k sparsification, per worker per leaf.  k is
+    static: ceil(ratio · leaf_size) — jit-stable shapes."""
+    if ratio >= 1.0:
+        return tree
+
+    def comp(x):
+        w = x.shape[0]
+        n = math.prod(x.shape[1:]) or 1
+        k = max(int(math.ceil(ratio * n)), 1)
+        flat = x.reshape(w, n).astype(jnp.float32)
+        return _per_worker_topk(flat, k).reshape(x.shape).astype(x.dtype)
+
+    return jax.tree.map(comp, tree)
+
+
+def rand_k_compress(tree, ratio: float, key):
+    """Random-k sparsification with 1/ratio rescaling (unbiased).  The
+    mask is drawn from ``key`` per leaf — pass a per-round key so
+    workers/rounds decorrelate."""
+    if ratio >= 1.0:
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+
+    def comp(x, k):
+        mask = (jax.random.uniform(k, x.shape) < ratio).astype(x.dtype)
+        return x * mask / jnp.asarray(ratio, x.dtype)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [comp(x, k) for x, k in zip(leaves, keys)])
+
+
+def make_compressor(name: str, ratio: float):
+    """Operator factory: (tree, key) → compressed tree.
+
+    'topk'  — deterministic magnitude top-k (ignores the key)
+    'randk' — unbiased random-k with rescaling
+    'none'  — identity (ratio ignored)
+    """
+    if name not in ("none", "topk", "randk"):
+        raise ValueError(f"unknown compressor {name!r}; one of none|topk|randk")
+    if name != "none" and not 0.0 < ratio <= 1.0:
+        # ratio=0 would divide by zero in randk (NaN params on round 0)
+        # and negative ratios would silently zero all communication.
+        raise ValueError(f"compression_ratio must be in (0, 1], got {ratio}")
+    if name == "none" or ratio >= 1.0:
+        return lambda tree, key: tree
+    if name == "topk":
+        return lambda tree, key: top_k_compress(tree, ratio)
+    return lambda tree, key: rand_k_compress(tree, ratio, key)

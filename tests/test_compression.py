@@ -1,0 +1,139 @@
+"""Compression operators + CHOCO-SGD engine semantics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dopt.ops.compression import (make_compressor, rand_k_compress,
+                                  top_k_compress)
+from tests.test_engine import _gossip_cfg
+from dopt.engine import GossipTrainer
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"a": jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(4, 3, 5)).astype(np.float32))}
+
+
+def test_topk_keeps_largest_per_worker():
+    tree = _tree()
+    out = top_k_compress(tree, 0.3)
+    for k in tree:
+        x = np.asarray(tree[k]).reshape(4, -1)
+        y = np.asarray(out[k]).reshape(4, -1)
+        n = x.shape[1]
+        keep = int(np.ceil(0.3 * n))
+        for w in range(4):
+            nz = np.nonzero(y[w])[0]
+            assert len(nz) == keep
+            # kept entries are exactly the top-|.| ones
+            thresh = np.sort(np.abs(x[w]))[-keep]
+            assert np.all(np.abs(x[w][nz]) >= thresh - 1e-12)
+            np.testing.assert_array_equal(y[w][nz], x[w][nz])
+
+
+def test_ratio_one_is_identity():
+    tree = _tree()
+    for name in ("topk", "randk", "none"):
+        comp = make_compressor(name, 1.0)
+        out = comp(tree, jax.random.key(0))
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.asarray(tree[k]))
+
+
+def test_randk_unbiased_rescaling():
+    tree = {"a": jnp.ones((2, 2000), jnp.float32)}
+    out = rand_k_compress(tree, 0.25, jax.random.key(3))
+    y = np.asarray(out["a"])
+    kept = y != 0
+    # kept entries rescaled by 1/ratio; empirical mean ~= original mean
+    np.testing.assert_allclose(y[kept], 4.0)
+    assert abs(y.mean() - 1.0) < 0.15
+
+
+def test_choco_identity_compression_equals_dsgd(devices):
+    # Q = identity, gamma = 1: CHOCO reduces exactly to D-SGD.
+    def run(algorithm, **extra):
+        cfg = _gossip_cfg(gossip=dict(algorithm=algorithm, rounds=3, **extra))
+        tr = GossipTrainer(cfg)
+        tr.run()
+        return tr
+
+    a = run("dsgd")
+    b = run("choco", compression="none", choco_gamma=1.0)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(a.history["avg_test_acc"],
+                               b.history["avg_test_acc"], atol=1e-5)
+
+
+def test_choco_topk_learns_and_contracts(devices):
+    # 20% top-k compressed gossip still learns and keeps workers close.
+    cfg = _gossip_cfg(gossip=dict(algorithm="choco", rounds=6,
+                                  compression="topk",
+                                  compression_ratio=0.2,
+                                  choco_gamma=0.8))
+    tr = GossipTrainer(cfg)
+    h = tr.run()
+    assert h.last()["avg_test_acc"] > 0.5
+    # public copies track params: residual shrinks below the raw scale
+    p = jax.device_get(tr.params)
+    xh = jax.device_get(tr.x_hat)
+    num = sum(float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+              for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(xh)))
+    den = sum(float(np.abs(np.asarray(a)).sum()) for a in jax.tree.leaves(p))
+    assert num / den < 1.0
+
+
+def test_choco_blocked_matches_per_round(devices):
+    def run(block):
+        cfg = _gossip_cfg(gossip=dict(algorithm="choco", rounds=4,
+                                      compression="topk",
+                                      compression_ratio=0.3,
+                                      choco_gamma=0.9))
+        tr = GossipTrainer(cfg)
+        tr.run(rounds=4, block=block)
+        return tr
+
+    a = run(1)
+    b = run(2)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(a.history["avg_train_loss"],
+                               b.history["avg_train_loss"], atol=1e-5)
+
+
+def test_choco_checkpoint_roundtrip(devices, tmp_path):
+    cfg = _gossip_cfg(gossip=dict(algorithm="choco", rounds=2,
+                                  compression="topk",
+                                  compression_ratio=0.5))
+    a = GossipTrainer(cfg)
+    a.run(rounds=2)
+    a.save(tmp_path / "ck")
+    a.run(rounds=2)  # continuous: 4 rounds total
+
+    b = GossipTrainer(cfg)
+    b.restore(tmp_path / "ck")
+    assert b.round == 2
+    b.run(rounds=2)  # resumed: rounds 2-3
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_compressor_rejects_bad_ratio():
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError):
+            make_compressor("randk", bad)
+    with pytest.raises(ValueError):
+        make_compressor("qsgd", 0.5)
