@@ -88,8 +88,9 @@ class FederatedConfig:
 class GossipConfig:
     """Serverless gossip/consensus path (reference P2 ``simulators.py``)."""
 
-    algorithm: str = "dsgd"     # dsgd | nocons | centralized | fedlcon | gossip
+    algorithm: str = "dsgd"     # dsgd | nocons | centralized | fedlcon | gossip | choco
     topology: str = "circle"    # circle | star | complete | dynamic | random
+    #                           # | torus | hierarchical
     mode: str = "stochastic"    # stochastic | double_stochastic | metropolis | uniform | ones
     rounds: int = 10
     local_ep: int = 4
@@ -103,6 +104,8 @@ class GossipConfig:
     # stale new_weights accumulation, simulators.py:189-196) for oracle
     # comparison; the idiomatic path fixes them.
     self_weight: bool = False   # reference mixing has zero diagonal (SURVEY §6.2)
+    hier_groups: int = 2        # topology='hierarchical': group count
+    hier_period: int = 4        # ... global (cross-DCN) mix every N rounds
     choco_gamma: float = 1.0    # CHOCO-SGD consensus step size γ
     compression: str = "topk"   # CHOCO compressor: topk | randk | none
     compression_ratio: float = 1.0  # fraction of entries communicated

@@ -167,6 +167,7 @@ class GossipTrainer:
         if g.algorithm in ("dsgd", "fedlcon", "choco"):
             self.mixing: MixingMatrices | None = build_mixing_matrices(
                 g.topology, g.mode, w, seed=cfg.seed, self_weight=g.self_weight,
+                groups=g.hier_groups, period=g.hier_period,
             )
         else:
             self.mixing = None

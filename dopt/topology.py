@@ -39,7 +39,8 @@ from typing import Sequence
 import numpy as np
 
 # Reference spells it "compelete" (simulators.py:54); accept both.
-_TOPOLOGIES = ("circle", "ring", "star", "complete", "compelete", "dynamic", "random", "torus")
+_TOPOLOGIES = ("circle", "ring", "star", "complete", "compelete", "dynamic",
+               "random", "torus", "hierarchical")
 _MODES = ("stochastic", "double_stochastic", "ones", "metropolis", "uniform")
 
 
@@ -102,6 +103,30 @@ class Topology:
         return graphs
 
     @staticmethod
+    def hierarchical(n: int, *, groups: int = 2,
+                     period: int = 4) -> list[np.ndarray]:
+        """DCN-aware two-level schedule for hybrid (hosts × ici) meshes:
+        rounds t % period != 0 mix within contiguous groups only (block-
+        diagonal complete graphs — zero DCN edges, pure ICI traffic);
+        every period-th round mixes globally.  This is hierarchical /
+        semi-decentralized averaging (HierFAVG-style) expressed purely
+        as topology data — the engine needs no special casing.  Group
+        layout matches ``make_hybrid_mesh``: worker i belongs to group
+        i // (n // groups)."""
+        if n % groups:
+            raise ValueError(f"{n} workers do not split into {groups} groups")
+        if period < 2:
+            raise ValueError(f"period must be >= 2, got {period}")
+        size = n // groups
+        intra = np.zeros((n, n))
+        for g in range(groups):
+            s = g * size
+            blk = np.ones((size, size)) - np.eye(size)
+            intra[s:s + size, s:s + size] = blk
+        global_g = np.ones((n, n)) - np.eye(n)
+        return [global_g] + [intra] * (period - 1)
+
+    @staticmethod
     def torus(n: int) -> list[np.ndarray]:
         """2D torus (matches TPU ICI physical topology when n = r*c)."""
         r = int(np.sqrt(n))
@@ -119,7 +144,8 @@ class Topology:
 
 
 def build_adjacency(topology: str, n: int, *, p: float = 0.5, schedule_len: int = 10,
-                    seed: int = 0) -> list[np.ndarray]:
+                    seed: int = 0, groups: int = 2,
+                    period: int = 4) -> list[np.ndarray]:
     t = topology.lower()
     if t not in _TOPOLOGIES:
         raise ValueError(f"unknown topology {topology!r}; one of {_TOPOLOGIES}")
@@ -130,6 +156,8 @@ def build_adjacency(topology: str, n: int, *, p: float = 0.5, schedule_len: int 
     if t == "random":
         return Topology.random(n, p=p, schedule_len=schedule_len,
                                rng=np.random.default_rng(seed))
+    if t == "hierarchical":
+        return Topology.hierarchical(n, groups=groups, period=period)
     return getattr(Topology, t)(n)
 
 
@@ -260,6 +288,8 @@ def build_mixing_matrices(
     self_weight: bool = False,
     p: float = 0.5,
     schedule_len: int = 10,
+    groups: int = 2,
+    period: int = 4,
 ) -> MixingMatrices:
     """Build the mixing-matrix schedule for a topology/mode pair.
 
@@ -273,7 +303,8 @@ def build_mixing_matrices(
         # Weighted Average.ipynb cell 29).  We accept it explicitly as
         # 'ones' but reject typos loudly.
         raise ValueError(f"unknown mode {mode!r}; one of {_MODES}")
-    graphs = build_adjacency(topology, n, p=p, schedule_len=schedule_len, seed=seed)
+    graphs = build_adjacency(topology, n, p=p, schedule_len=schedule_len,
+                             seed=seed, groups=groups, period=period)
     rng = np.random.default_rng(seed)
 
     if mode_l == "stochastic":

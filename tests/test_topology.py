@@ -190,3 +190,33 @@ def test_repair_for_dropout_all_alive_identity_op():
 
     w = build_mixing_matrices("circle", "stochastic", 8, seed=1).matrices[0]
     np.testing.assert_allclose(repair_for_dropout(w, np.ones(8)), w)
+
+
+def test_hierarchical_schedule_structure():
+    from dopt.topology import Topology, build_mixing_matrices
+    from dopt.parallel.multihost import dcn_edge_count
+
+    graphs = Topology.hierarchical(8, groups=2, period=4)
+    assert len(graphs) == 4
+    # round 0: global mix (crosses DCN); rounds 1-3: intra-group only
+    assert dcn_edge_count(graphs[0], 2) > 0
+    for g in graphs[1:]:
+        assert dcn_edge_count(g, 2) == 0
+        # block-diagonal complete: worker 0 sees 1-3 but not 4-7
+        assert g[0, 1] == 1.0 and g[0, 4] == 0.0
+
+    mm = build_mixing_matrices("hierarchical", "metropolis", 8,
+                               groups=2, period=4)
+    assert mm.is_row_stochastic()
+    # for_round cycles: global at t % 4 == 0
+    assert (mm.for_round(0) == mm.for_round(4)).all()
+    assert not (mm.for_round(0) == mm.for_round(1)).all()
+
+
+def test_hierarchical_validation():
+    from dopt.topology import Topology
+
+    with pytest.raises(ValueError):
+        Topology.hierarchical(9, groups=2)
+    with pytest.raises(ValueError):
+        Topology.hierarchical(8, groups=2, period=1)
