@@ -28,12 +28,37 @@ from dopt.config import (
     GossipConfig,
     ModelConfig,
     OptimizerConfig,
+    from_reference_args,
 )
 from dopt.topology import MixingMatrices, Topology, build_mixing_matrices
 
 __version__ = "0.1.0"
 
+# Heavy entry points resolve lazily (PEP 562) so `import dopt` stays
+# cheap: the engines pull in flax/model code only when actually used.
+_LAZY = {
+    "GossipTrainer": ("dopt.engine", "GossipTrainer"),
+    "FederatedTrainer": ("dopt.engine", "FederatedTrainer"),
+    "build_model": ("dopt.models", "build_model"),
+    "get_preset": ("dopt.presets", "get_preset"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'dopt' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)
+
+
 __all__ = [
+    "from_reference_args",
     "DataConfig",
     "ExperimentConfig",
     "FederatedConfig",
@@ -43,4 +68,5 @@ __all__ = [
     "MixingMatrices",
     "Topology",
     "build_mixing_matrices",
+    *_LAZY,
 ]

@@ -107,12 +107,15 @@ class GossipConfig:
     hier_groups: int = 2        # topology='hierarchical': group count
     hier_period: int = 4        # ... global (cross-DCN) mix every N rounds
     choco_gamma: float = 1.0    # CHOCO-SGD consensus step size γ
-    compression: str = "topk"   # CHOCO compressor: topk | randk | none
-    compression_ratio: float = 1.0  # fraction of entries communicated
+    compression: str = "topk"   # CHOCO compressor: topk | randk | qsgd | none
+    compression_ratio: float = 1.0
+    # topk/randk: fraction of entries communicated (ratio=1 = identity;
+    # with γ=1 that reduces exactly to D-SGD — tested).  qsgd: ratio
+    # sets the quantization level count (ratio=1 → 256 levels, not the
+    # identity — use compression='none' for the exact reduction).
     # algorithm='choco' (Koloskova et al. 2019): workers gossip a
     # COMPRESSED difference Q(x_i − x̂_i) with error feedback, then take
-    # the consensus step x_i += γ·((W x̂)_i − x̂_i).  ratio=1 with γ=1
-    # reduces exactly to D-SGD (tested).
+    # the consensus step x_i += γ·((W x̂)_i − x̂_i).
     comm_dtype: str | None = None
     # Communication compression for the consensus collective: e.g.
     # "bfloat16" narrows model shards BEFORE the cross-worker

@@ -12,10 +12,13 @@ All operators are pure, shape-static (XLA-friendly: ``top_k`` with a
 compile-time k, seeded masks instead of data-dependent sparsity), and
 act per worker on stacked [W, ...] pytrees.
 
-Contract: an operator maps (tree, key) → tree of the same structure
-where each worker's leaf slice retains ``ratio`` of its mass per the
-operator's rule and the rest is zero.  ``ratio=1.0`` must be the exact
-identity — that invariant is what the choco≡dsgd reduction test pins.
+Contract: an operator maps (tree, key) → tree of the same structure.
+For the SPARSIFIERS (``topk``, ``randk``) ``ratio`` is the fraction of
+entries communicated and ``ratio=1.0`` is the exact identity — that
+invariant is what the choco≡dsgd reduction test pins.  ``qsgd`` is a
+QUANTIZER with different ratio semantics: ratio sets the level count
+(ratio=1 → 256-level stochastic quantization, NOT the identity); use
+``compression='none'`` for the exact D-SGD reduction.
 """
 
 from __future__ import annotations
@@ -70,21 +73,65 @@ def rand_k_compress(tree, ratio: float, key):
         treedef, [comp(x, k) for x, k in zip(leaves, keys)])
 
 
+def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048):
+    """QSGD stochastic quantization (Alistarh et al. 2017), per worker
+    per leaf: x → ‖x‖₂ · sign(x) · ξ(x)/s with ξ an unbiased stochastic
+    rounding of s·|x|/‖x‖₂ to integer levels.  ``ratio`` sets the level
+    count s = max(round(ratio · 256), 1) — the fraction of an 8-bit
+    range used; smaller ratio = coarser quantization = fewer wire bits
+    in a real packed transport.
+
+    Norms are per ``bucket_size`` chunk (standard QSGD bucketing):
+    without it the quantization step scales with the WHOLE leaf's norm
+    (~√N · rms) and the noise swamps million-parameter models."""
+    s = max(int(round(ratio * 256)), 1)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+
+    def comp(x, k):
+        w = x.shape[0]
+        n = math.prod(x.shape[1:]) or 1
+        b = min(bucket_size, n)
+        nb = -(-n // b)
+        pad = nb * b - n
+        flat = x.reshape(w, n).astype(jnp.float32)
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        bk = flat.reshape(w, nb, b)
+        norm = jnp.linalg.norm(bk, axis=2, keepdims=True)
+        safe = jnp.maximum(norm, 1e-12)
+        level = s * jnp.abs(bk) / safe                     # in [0, s]
+        floor = jnp.floor(level)
+        frac = level - floor
+        up = (jax.random.uniform(k, bk.shape) < frac).astype(jnp.float32)
+        q = jnp.sign(bk) * (floor + up) * safe / s
+        q = jnp.where(norm > 0, q, 0.0)
+        q = q.reshape(w, nb * b)[:, :n]
+        return q.reshape(x.shape).astype(x.dtype)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [comp(x, k) for x, k in zip(leaves, keys)])
+
+
 def make_compressor(name: str, ratio: float):
     """Operator factory: (tree, key) → compressed tree.
 
     'topk'  — deterministic magnitude top-k (ignores the key)
     'randk' — unbiased random-k with rescaling
+    'qsgd'  — unbiased stochastic quantization (ratio sets level count)
     'none'  — identity (ratio ignored)
     """
-    if name not in ("none", "topk", "randk"):
-        raise ValueError(f"unknown compressor {name!r}; one of none|topk|randk")
+    if name not in ("none", "topk", "randk", "qsgd"):
+        raise ValueError(
+            f"unknown compressor {name!r}; one of none|topk|randk|qsgd")
     if name != "none" and not 0.0 < ratio <= 1.0:
         # ratio=0 would divide by zero in randk (NaN params on round 0)
         # and negative ratios would silently zero all communication.
         raise ValueError(f"compression_ratio must be in (0, 1], got {ratio}")
-    if name == "none" or ratio >= 1.0:
+    if name == "none" or (name != "qsgd" and ratio >= 1.0):
         return lambda tree, key: tree
     if name == "topk":
         return lambda tree, key: top_k_compress(tree, ratio)
+    if name == "qsgd":
+        return lambda tree, key: qsgd_compress(tree, ratio, key)
     return lambda tree, key: rand_k_compress(tree, ratio, key)

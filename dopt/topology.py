@@ -106,9 +106,13 @@ class Topology:
     def hierarchical(n: int, *, groups: int = 2,
                      period: int = 4) -> list[np.ndarray]:
         """DCN-aware two-level schedule for hybrid (hosts × ici) meshes:
-        rounds t % period != 0 mix within contiguous groups only (block-
-        diagonal complete graphs — zero DCN edges, pure ICI traffic);
-        every period-th round mixes globally.  This is hierarchical /
+        period−1 intra-group rounds (block-diagonal complete graphs —
+        zero DCN edges, pure ICI traffic) followed by one global round,
+        cycling.  The global mix sits LAST in the cycle, not first: the
+        engine mixes at the start of each round and all workers share
+        one init, so a round-0 global mix would average identical
+        parameters — a no-op that would delay the first real cross-group
+        exchange by a whole period.  This is hierarchical /
         semi-decentralized averaging (HierFAVG-style) expressed purely
         as topology data — the engine needs no special casing.  Group
         layout matches ``make_hybrid_mesh``: worker i belongs to group
@@ -124,7 +128,7 @@ class Topology:
             blk = np.ones((size, size)) - np.eye(size)
             intra[s:s + size, s:s + size] = blk
         global_g = np.ones((n, n)) - np.eye(n)
-        return [global_g] + [intra] * (period - 1)
+        return [intra] * (period - 1) + [global_g]
 
     @staticmethod
     def torus(n: int) -> list[np.ndarray]:

@@ -136,4 +136,34 @@ def test_compressor_rejects_bad_ratio():
         with pytest.raises(ValueError):
             make_compressor("randk", bad)
     with pytest.raises(ValueError):
-        make_compressor("qsgd", 0.5)
+        make_compressor("signsgd", 0.5)
+
+
+def test_qsgd_unbiased_and_bounded():
+    from dopt.ops.compression import qsgd_compress
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4000)).astype(np.float32))
+    tree = {"a": x}
+    # Average many independent quantizations -> unbiased estimate of x.
+    acc = np.zeros((2, 4000), np.float64)
+    trials = 50
+    for i in range(trials):
+        out = qsgd_compress(tree, 0.25, jax.random.key(i), bucket_size=256)
+        acc += np.asarray(out["a"], np.float64)
+    mean = acc / trials
+    err = np.abs(mean - np.asarray(x)).mean()
+    assert err < 0.03
+    # zero input stays exactly zero
+    z = qsgd_compress({"a": jnp.zeros((2, 8))}, 0.25, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(z["a"]), 0.0)
+
+
+def test_choco_qsgd_learns(devices):
+    cfg = _gossip_cfg(gossip=dict(algorithm="choco", rounds=5,
+                                  compression="qsgd",
+                                  compression_ratio=0.1,
+                                  choco_gamma=0.8))
+    tr = GossipTrainer(cfg)
+    h = tr.run()
+    assert h.last()["avg_test_acc"] > 0.5

@@ -198,9 +198,11 @@ def test_hierarchical_schedule_structure():
 
     graphs = Topology.hierarchical(8, groups=2, period=4)
     assert len(graphs) == 4
-    # round 0: global mix (crosses DCN); rounds 1-3: intra-group only
-    assert dcn_edge_count(graphs[0], 2) > 0
-    for g in graphs[1:]:
+    # rounds 0-2: intra-group only; round 3 (cycle end): global mix.
+    # Global mixes LAST — a round-0 global mix would average the
+    # workers' identical init, a no-op.
+    assert dcn_edge_count(graphs[-1], 2) > 0
+    for g in graphs[:-1]:
         assert dcn_edge_count(g, 2) == 0
         # block-diagonal complete: worker 0 sees 1-3 but not 4-7
         assert g[0, 1] == 1.0 and g[0, 4] == 0.0
@@ -208,9 +210,9 @@ def test_hierarchical_schedule_structure():
     mm = build_mixing_matrices("hierarchical", "metropolis", 8,
                                groups=2, period=4)
     assert mm.is_row_stochastic()
-    # for_round cycles: global at t % 4 == 0
-    assert (mm.for_round(0) == mm.for_round(4)).all()
-    assert not (mm.for_round(0) == mm.for_round(1)).all()
+    # for_round cycles with the global matrix at t % 4 == 3
+    assert (mm.for_round(3) == mm.for_round(7)).all()
+    assert not (mm.for_round(0) == mm.for_round(3)).all()
 
 
 def test_hierarchical_validation():
