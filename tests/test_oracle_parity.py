@@ -274,3 +274,21 @@ def test_full_gossip_round_parity_vs_trainer():
             np.testing.assert_allclose(
                 np.asarray(a), b, atol=2e-4, rtol=1e-3,
                 err_msg=f"round-trajectory divergence worker {i}: {ka}")
+
+
+def test_trajectory_script_smoke():
+    """The oracle-trajectory artifact generator stays runnable and its
+    round-1 divergence stays at float-noise scale."""
+    import importlib.util
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "oracle_trajectory", root / "scripts" / "oracle_trajectory.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    g = mod.gossip_trajectory("circle", "stochastic", 1)
+    assert g["rel_l2_per_round"][0] < 0.01
+    f = mod.federated_trajectory("fedavg", 1)
+    assert f["rel_l2_per_round"][0] < 0.01
