@@ -8,8 +8,70 @@ history CSV in the reference's results layout, optionally checkpoint.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
+import re
 import sys
+
+
+def apply_override(cfg, spec: str):
+    """``--set path.to.field=value``: frozen-dataclass field override by
+    dotted path.  The value is coerced from the FIELD ANNOTATION (not
+    the current value, which may be None), with strict bool parsing and
+    clean SystemExit errors for every bad input."""
+    path, eq, raw = spec.partition("=")
+    if not eq:
+        raise SystemExit(f"--set expects PATH=VALUE, got {spec!r}")
+    parts = path.split(".")
+    objs = [cfg]
+    for p in parts[:-1]:
+        names = {f.name for f in dataclasses.fields(objs[-1])}
+        if p not in names:
+            raise SystemExit(f"--set: {path!r} not found on this preset")
+        nxt = getattr(objs[-1], p)
+        if not dataclasses.is_dataclass(nxt):
+            raise SystemExit(
+                f"--set: {'.'.join(parts[:parts.index(p) + 1])!r} is not "
+                f"configured on this preset (value: {nxt!r})")
+        objs.append(nxt)
+    leaf = parts[-1]
+    fields = {f.name: f for f in dataclasses.fields(objs[-1])}
+    if leaf not in fields:
+        raise SystemExit(f"--set: {path!r} not found on this preset")
+    ann = str(fields[leaf].type)
+    m = re.match(r"[A-Za-z_]+", ann.strip())
+    primary = m.group(0) if m else ann
+    if raw.lower() in ("none", "null") and "None" in ann:
+        val = None
+    elif primary == "bool":
+        low = raw.lower()
+        if low in ("1", "true", "yes"):
+            val = True
+        elif low in ("0", "false", "no"):
+            val = False
+        else:
+            raise SystemExit(
+                f"--set: {path!r} is a bool; use true/false, got {raw!r}")
+    elif primary == "int":
+        try:
+            val = int(raw)
+        except ValueError:
+            raise SystemExit(f"--set: {path!r} expects an int, got {raw!r}")
+    elif primary == "float":
+        try:
+            val = float(raw)
+        except ValueError:
+            raise SystemExit(f"--set: {path!r} expects a float, got {raw!r}")
+    elif primary == "str":
+        val = raw
+    else:
+        raise SystemExit(
+            f"--set: field {path!r} of type {ann!r} is not settable "
+            "from the CLI")
+    new = dataclasses.replace(objs[-1], **{leaf: val})
+    for obj, name in zip(reversed(objs[:-1]), reversed(parts[:-1])):
+        new = dataclasses.replace(obj, **{name: new})
+    return new
 
 
 def build_trainer(cfg):
@@ -39,6 +101,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="capture a jax/XLA profiler trace of the run "
                          "into DIR (view with tensorboard or xprof)")
+    ap.add_argument("--set", action="append", default=[], metavar="PATH=VAL",
+                    dest="overrides",
+                    help="override any config field by dotted path, e.g. "
+                         "--set gossip.topology=hierarchical "
+                         "--set optim.lr=0.05 --set seed=7; value is coerced "
+                         "to the field's current type")
     args = ap.parse_args(argv)
 
     from dopt.presets import PRESETS, get_preset
@@ -48,9 +116,9 @@ def main(argv: list[str] | None = None) -> int:
             print(name)
         return 0
 
-    import dataclasses
-
     cfg = get_preset(args.preset)
+    for spec in args.overrides:
+        cfg = apply_override(cfg, spec)
     if args.num_users is not None:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data,
                                                    num_users=args.num_users))

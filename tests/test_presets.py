@@ -50,3 +50,65 @@ def test_cli_resume(devices, tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert '"round": 1' in out  # continued from round 1
+
+
+def test_cli_set_overrides(capsys):
+    # baseline1 is the MLP config — conv models on the 1-core virtual
+    # CPU mesh are far too slow for a CLI smoke test.
+    from dopt.run import main
+
+    rc = main(["--preset", "baseline1", "--rounds", "1",
+               "--synthetic-scale", "0.05",
+               "--set", "gossip.topology=complete",
+               "--set", "gossip.mode=metropolis",
+               "--set", "gossip.local_ep=1",
+               "--set", "optim.lr=0.02",
+               "--set", "seed=3"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "complete" in err and "0.02" in err
+
+
+def test_cli_set_rejects_unknown_path():
+    import pytest
+
+    from dopt.run import main
+
+    with pytest.raises(SystemExit):
+        main(["--preset", "reference-dsgd-circle", "--set", "nope.lr=1"])
+    with pytest.raises(SystemExit):
+        main(["--preset", "reference-dsgd-circle", "--set", "badspec"])
+
+
+def test_apply_override_annotation_coercion():
+    import pytest
+
+    from dopt.presets import get_preset
+    from dopt.run import apply_override
+
+    cfg = get_preset("baseline3")  # federated preset
+    # None-valued optional bool coerces from the annotation, not type(None)
+    c = apply_override(cfg, "federated.compact=false")
+    assert c.federated.compact is False
+    c = apply_override(cfg, "federated.compact=true")
+    assert c.federated.compact is True
+    # optional int
+    c = apply_override(cfg, "mesh_devices=2")
+    assert c.mesh_devices == 2
+    # explicit None for optional fields
+    c = apply_override(c, "mesh_devices=none")
+    assert c.mesh_devices is None
+    # strict bool: typos raise instead of silently meaning False
+    with pytest.raises(SystemExit):
+        apply_override(cfg, "data.iid=ture")
+    # bad numerics raise cleanly
+    with pytest.raises(SystemExit):
+        apply_override(cfg, "federated.rounds=2.5")
+    with pytest.raises(SystemExit):
+        apply_override(cfg, "optim.lr=abc")
+    # properties/methods are not fields
+    with pytest.raises(SystemExit):
+        apply_override(cfg, "gossip.topology=x")  # gossip is None here
+    # unsupported field types are refused
+    with pytest.raises(SystemExit):
+        apply_override(cfg, "model.input_shape=3")

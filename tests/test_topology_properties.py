@@ -1,0 +1,89 @@
+"""Property-based invariants for the topology/mixing layer (hypothesis).
+
+The example-based tests in test_topology.py pin the reference's exact
+semantics; these sweep the (topology, mode, n) space for the structural
+invariants every engine path relies on:
+
+* row-stochasticity (consensus is an average, never a scale drift)
+* zero diagonal without self_weight (reference semantics, SURVEY §6.2)
+* doubly-stochastic modes also column-sum to 1
+* dropout repair preserves row-stochasticity over the survivors
+* shift_decomposition reconstructs circulant matrices exactly
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from dopt.topology import (build_mixing_matrices, repair_for_dropout,
+                           shift_decomposition)
+
+TOPOLOGIES = st.sampled_from(["circle", "star", "complete", "dynamic",
+                              "random", "torus"])
+MODES = st.sampled_from(["stochastic", "metropolis", "uniform"])
+NS = st.integers(min_value=3, max_value=12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(topology=TOPOLOGIES, mode=MODES, n=NS, seed=st.integers(0, 2**16))
+def test_mixing_row_stochastic_and_zero_diag(topology, mode, n, seed):
+    mm = build_mixing_matrices(topology, mode, n, seed=seed)
+    assert mm.is_row_stochastic()
+    if mode != "metropolis":  # metropolis keeps self-loops by construction
+        for m in mm.matrices:
+            diag = np.diag(m)
+            off = m.sum(axis=1) - diag
+            for i in range(m.shape[0]):
+                if off[i] > 0:
+                    # connected workers: reference zero-diagonal semantics
+                    assert abs(diag[i]) < 1e-12
+                else:
+                    # isolated workers (dynamic/random single-edge rounds)
+                    # keep their own weights — self-loop of exactly 1
+                    # (the fix for the reference's zero-row NaN)
+                    np.testing.assert_allclose(diag[i], 1.0, atol=1e-12)
+
+
+@settings(max_examples=20, deadline=None)
+@given(topology=st.sampled_from(["circle", "complete", "torus"]),
+       n=st.integers(min_value=3, max_value=10),
+       seed=st.integers(0, 2**16))
+def test_double_stochastic_columns_sum_to_one(topology, n, seed):
+    mm = build_mixing_matrices(topology, "double_stochastic", n, seed=seed)
+    for m in mm.matrices:
+        np.testing.assert_allclose(m.sum(axis=0), 1.0, atol=1e-6)
+        np.testing.assert_allclose(m.sum(axis=1), 1.0, atol=1e-6)
+
+
+@settings(max_examples=30, deadline=None)
+@given(n=st.integers(min_value=3, max_value=12),
+       seed=st.integers(0, 2**16),
+       data=st.data())
+def test_dropout_repair_keeps_survivor_rows_stochastic(n, seed, data):
+    mm = build_mixing_matrices("complete", "metropolis", n, seed=seed)
+    alive = np.asarray(
+        data.draw(st.lists(st.sampled_from([0.0, 1.0]),
+                           min_size=n, max_size=n)), np.float32)
+    if alive.sum() == 0:
+        alive[0] = 1.0  # engine guarantees at least one survivor
+    w = repair_for_dropout(mm.matrices[0], alive)
+    for i in range(n):
+        if alive[i]:
+            np.testing.assert_allclose(w[i].sum(), 1.0, atol=1e-6)
+            # no weight flows from dead workers
+            assert np.all(w[i][alive == 0.0] == 0.0)
+
+
+@settings(max_examples=30, deadline=None)
+@given(n=st.integers(min_value=3, max_value=10),
+       seed=st.integers(0, 2**16))
+def test_shift_decomposition_reconstructs_circulant(n, seed):
+    rng = np.random.default_rng(seed)
+    # random circulant built from a random first row
+    row = rng.random(n)
+    w = np.stack([np.roll(row, i) for i in range(n)])
+    shifts = shift_decomposition(w)
+    rec = np.zeros_like(w)
+    for s, coeffs in shifts:
+        for i in range(n):
+            rec[i, (i + s) % n] += coeffs[i]
+    np.testing.assert_allclose(rec, w, atol=1e-12)
