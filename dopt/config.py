@@ -82,6 +82,11 @@ class FederatedConfig:
     # >1 fuses that many rounds into one lax.scan jit dispatch (same
     # math, same per-round eval cadence) — the dispatch-overhead killer
     # for small models; mirrors GossipConfig.block_rounds.
+    comm_dtype: str | None = None
+    # Wire-only compression of the aggregation reduce (full-width path
+    # on a sharded mesh): per-device partial sums cross ICI/DCN at this
+    # dtype (e.g. "bfloat16"); local math stays full precision.
+    # Mirrors GossipConfig.comm_dtype.
 
 
 @dataclass(frozen=True)

@@ -144,6 +144,11 @@ class FederatedTrainer:
         )
         global_eval = make_evaluator(self.model.apply)
         algorithm = f.algorithm
+        # comm_dtype applies on ANY mesh size (a 1-device mesh still
+        # quantizes, matching the gossip engine, so single-device debug
+        # runs reproduce multi-device numerics).
+        agg_mesh = self.mesh
+        agg_comm = jnp.dtype(f.comm_dtype) if f.comm_dtype else None
         rho = cfg.optim.rho
         lr = cfg.optim.lr
         momentum_coef = cfg.optim.momentum
@@ -235,7 +240,8 @@ class FederatedTrainer:
             # not checkpointed; the other algorithms persist it like the
             # reference's lifetime client optimizers.
             new_m = mom if algorithm == "scaffold" else _where_mask(mask, m_t, mom)
-            new_theta = masked_average(new_p, mask)
+            new_theta = masked_average(new_p, mask, mesh=agg_mesh,
+                                       comm_dtype=agg_comm)
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
             return finish(new_theta, new_p, new_m, new_duals, new_c,
                           local_loss, train_x, train_y, ex, ey, ew, tidx,
@@ -331,6 +337,17 @@ class FederatedTrainer:
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
+        if f.comm_dtype:
+            # The compact path's aggregation is a local mean over m
+            # lanes — no cross-worker collective to compress — so the
+            # knob would silently not apply; force full-width (and
+            # reject an explicit compact=True request).
+            if f.compact:
+                raise ValueError(
+                    "FederatedConfig.compact=True is incompatible with "
+                    "comm_dtype (the compact path has no cross-worker "
+                    "collective to compress)")
+            return False
         if self.mesh.size > 1:
             # The compact path re-shapes the worker axis to m lanes and
             # never applies the mesh sharding — single-device only; on a

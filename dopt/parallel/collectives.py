@@ -153,18 +153,58 @@ def where_mask(mask, a, b):
     return jax.tree.map(sel, a, b)
 
 
-def masked_average(stacked, mask):
+def masked_average(stacked, mask, mesh: Mesh | None = None, comm_dtype=None):
     """Uniform average of the masked workers' states, replicated back to
     every worker: theta ← Σ_i m_i x_i / Σ_i m_i  (reference
     ``average_weights``, servers.py:42-48, with client sampling as data).
 
-    Returns a pytree WITHOUT the worker axis (the global model)."""
+    Returns a pytree WITHOUT the worker axis (the global model).
+
+    ``comm_dtype`` (requires ``mesh``) is wire-only compression of the
+    aggregation, mirroring ``mix_dense``: each device reduces its local
+    lanes at full precision, only the per-device PARTIAL sums cross the
+    wire at the narrow dtype (one psum), and the final divide runs at
+    the leaf dtype."""
     m = jnp.asarray(mask, dtype=jnp.float32)
     denom = jnp.maximum(m.sum(), 1.0)
+    if comm_dtype is not None:
+        if mesh is None:
+            raise ValueError("comm_dtype compression requires a mesh")
+        return _masked_average_compressed(stacked, m, denom, mesh, comm_dtype)
 
     def avg_leaf(x):
         mm = m.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
         return (x * mm).sum(axis=0) / denom.astype(x.dtype)
+
+    return jax.tree.map(avg_leaf, stacked)
+
+
+def _masked_average_compressed(stacked, m, denom, mesh: Mesh, comm_dtype):
+    """Wire-only compressed federated reduce: each device sums its local
+    lanes at full precision, the narrow PARTIAL sums are all-gathered
+    (the only bytes on the wire), and the cross-device accumulation runs
+    in float32 locally — so exactly one quantization per partial, never
+    a narrow-dtype summation chain that would grow error with device
+    count (mirrors ``_mix_dense_compressed``'s semantics)."""
+    from dopt.parallel.mesh import worker_axes
+
+    ax = worker_axes(mesh)
+
+    def avg_leaf(x):
+        def per_device(mask_l, x_l):
+            mm = mask_l.reshape((-1,) + (1,) * (x_l.ndim - 1))
+            part = (x_l.astype(jnp.float32) * mm).sum(axis=0)
+            parts = jax.lax.all_gather(part.astype(comm_dtype), ax)
+            tot = parts.astype(jnp.float32).sum(axis=0)
+            return (tot / denom).astype(x_l.dtype)
+
+        # all_gather+local-sum yields a value that IS replicated but
+        # can't be statically proven so (unlike psum); skip the static
+        # varying-axes check for this one collective.
+        fn = jax.shard_map(per_device, mesh=mesh,
+                           in_specs=(P(ax), P(ax)), out_specs=P(),
+                           check_vma=False)
+        return fn(m, x)
 
     return jax.tree.map(avg_leaf, stacked)
 

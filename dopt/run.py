@@ -41,6 +41,13 @@ def apply_override(cfg, spec: str):
     ann = str(fields[leaf].type)
     m = re.match(r"[A-Za-z_]+", ann.strip())
     primary = m.group(0) if m else ann
+    if primary not in ("bool", "int", "float", "str"):
+        # Checked FIRST so optional non-scalar subtrees (e.g.
+        # `gossip: GossipConfig | None`) can't be nulled via the
+        # none/null branch and crash later.
+        raise SystemExit(
+            f"--set: field {path!r} of type {ann!r} is not settable "
+            "from the CLI")
     if raw.lower() in ("none", "null") and "None" in ann:
         val = None
     elif primary == "bool":
@@ -62,12 +69,8 @@ def apply_override(cfg, spec: str):
             val = float(raw)
         except ValueError:
             raise SystemExit(f"--set: {path!r} expects a float, got {raw!r}")
-    elif primary == "str":
+    else:  # primary == "str"
         val = raw
-    else:
-        raise SystemExit(
-            f"--set: field {path!r} of type {ann!r} is not settable "
-            "from the CLI")
     new = dataclasses.replace(objs[-1], **{leaf: val})
     for obj, name in zip(reversed(objs[:-1]), reversed(parts[:-1])):
         new = dataclasses.replace(obj, **{name: new})
@@ -106,7 +109,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="override any config field by dotted path, e.g. "
                          "--set gossip.topology=hierarchical "
                          "--set optim.lr=0.05 --set seed=7; value is coerced "
-                         "to the field's current type")
+                         "to the field's annotated type")
     args = ap.parse_args(argv)
 
     from dopt.presets import PRESETS, get_preset

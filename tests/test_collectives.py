@@ -154,3 +154,19 @@ def test_mix_dense_comm_compression_requires_mesh(devices):
     with pytest.raises(ValueError, match="requires a mesh"):
         mix_dense(tree, np.eye(8, dtype=np.float32), None,
                   comm_dtype=jnp.bfloat16)
+
+
+def test_masked_average_comm_compression(devices):
+    mesh = make_mesh(8)
+    tree = shard_worker_tree(_tree(8), mesh)
+    mask = np.array([1, 0, 1, 1, 0, 1, 1, 1], np.float32)
+    exact = masked_average(tree, mask)
+    comp = jax.jit(
+        lambda t: masked_average(t, mask, mesh=mesh, comm_dtype=jnp.bfloat16)
+    )(tree)
+    for k in tree:
+        assert comp[k].shape == tree[k].shape[1:]
+        np.testing.assert_allclose(np.asarray(comp[k]), np.asarray(exact[k]),
+                                   atol=0.02, rtol=0.02)
+    with pytest.raises(ValueError, match="requires a mesh"):
+        masked_average(_tree(8), mask, comm_dtype=jnp.bfloat16)

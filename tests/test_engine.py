@@ -394,3 +394,13 @@ def test_hierarchical_gossip_on_hybrid_mesh(devices):
         gossip=dict(algorithm="nocons", rounds=4), iid=False))
     nocons.run()
     assert spread_of(tr) < 0.5 * spread_of(nocons)
+
+
+def test_federated_comm_compression_trains(devices):
+    cfg = _fed_cfg("fedavg")
+    cfg = cfg.replace(federated=dataclasses.replace(
+        cfg.federated, comm_dtype="bfloat16"))
+    tr = FederatedTrainer(cfg)
+    h = tr.run(rounds=3)
+    ref = FederatedTrainer(_fed_cfg("fedavg")).run(rounds=3)
+    assert abs(h.last()["test_acc"] - ref.last()["test_acc"]) < 0.1

@@ -112,3 +112,13 @@ def test_apply_override_annotation_coercion():
     # unsupported field types are refused
     with pytest.raises(SystemExit):
         apply_override(cfg, "model.input_shape=3")
+
+
+def test_apply_override_cannot_null_subtrees():
+    import pytest
+
+    from dopt.presets import get_preset
+    from dopt.run import apply_override
+
+    with pytest.raises(SystemExit):
+        apply_override(get_preset("baseline1"), "gossip=none")
