@@ -29,10 +29,27 @@ class DataConfig:
     data_dir: str | None = None   # directory with raw files; None -> auto/synthetic
     synthetic_train_size: int = 2048
     synthetic_test_size: int = 512
-    unequal: bool = False
+    # (no 'unequal' knob: the reference has no unequal split — P1's
+    # hardcoded shard tables and P2's args.shards are both equal-size —
+    # so the field would be a silent no-op; partitioners reject what
+    # they cannot honour instead.)
     plan_impl: str = "numpy"  # "native" = C++ host runtime (dopt.native)
     # for per-round batch-plan generation; numpy remains the
     # torch-oracle-parity mode
+    local_holdout: float = 0.0
+    # Fraction of each worker's shard held out as LOCAL validation, the
+    # reference's ``train_val_test`` split: ``val_size = max(int(L/10), 1)``
+    # and training runs on the remaining samples only (P1 clients.py:25-28,
+    # P2 clients.py:20-22).  0.1 reproduces the reference; 0.0 (default)
+    # trains on the full shard (the idiomatic mode).  When enabled the
+    # engines also emit per-epoch per-worker
+    # {train_loss, train_acc, val_acc, val_loss} rows (clients.py:45-50)
+    # into ``trainer.client_history``.
+    holdout_mode: str = "deterministic"
+    # deterministic — val = FIRST val_size indices of the worker's shard
+    #                 (P1, clients.py:26-28).
+    # random        — seeded random choice without replacement
+    #                 (P2, clients.py:21-22).
 
 
 @dataclass(frozen=True)
@@ -47,6 +64,11 @@ class ModelConfig:
     num_classes: int = 10
     input_shape: tuple[int, ...] = (28, 28, 1)   # NHWC (TPU-native layout)
     param_dtype: str = "float32"
+    # Storage dtype of the worker-stacked training state (params,
+    # momentum, duals/controls): "bfloat16" halves HBM for the [W, ...]
+    # stacked tree and the bytes every consensus/aggregation collective
+    # moves, at a numerics cost (the update itself then rounds to bf16
+    # each step).  float32 is the oracle-parity mode.
     compute_dtype: str = "float32"   # "bfloat16" for the fast path
 
 
@@ -55,9 +77,15 @@ class OptimizerConfig:
     """Local SGD settings (reference ``clients.py`` optimizer construction)."""
 
     optimizer: str = "sgd"
+    # Only 'sgd' exists (the reference's single optimizer,
+    # clients.py:14); anything else is rejected loudly at trainer
+    # construction rather than silently running SGD.
     lr: float = 0.01
     momentum: float = 0.5
     weight_decay: float = 0.0
+    # ℓ2 coefficient added to the local loss (λ‖θ‖²/2, as an explicit
+    # loss term rather than torch-style decoupled decay so FedProx/ADMM
+    # gradient edits compose with it identically on both backends).
     rho: float = 0.1   # FedProx proximal weight / FedADMM penalty
     fused_update: bool = False  # pallas single-pass momentum-SGD update
     # (dopt.ops.fused_update); numerics identical to the jnp path
@@ -101,6 +129,19 @@ class GossipConfig:
     local_ep: int = 4
     local_bs: int = 128
     eps: int = 1                # consensus sweeps per round (FedLCon)
+    comm_impl: str = "auto"     # consensus collective: auto | dense | shift
+    # 'dense'  — all_gather + contraction with the [n, n] mixing matrix
+    #            (right for complete/random/arbitrary graphs).
+    # 'shift'  — lax.ppermute per circulant diagonal of W over ICI:
+    #            O(k·|θ|) bytes/round instead of O(n·|θ|) (ring: k=2).
+    #            Requires workers == mesh devices on a flat 1-D mesh and
+    #            a topology whose schedule decomposes into shifts.
+    # 'auto'   — shift when those conditions hold and the shift count is
+    #            small (≤ max(2, n/2)); dense otherwise.
+    # Determinism note: runs are bit-reproducible for a fixed config AND
+    # mesh, but 'auto' picks per mesh shape, and the two paths can
+    # differ in the last float bit for non-dyadic weights (gemm FMA vs
+    # mul+add); pin 'dense' or 'shift' for cross-hardware bit-replay.
     block_rounds: int = 1       # rounds fused into ONE jit (lax.scan) per
     # dispatch; >1 removes per-round host sync + dispatch overhead (the
     # fast path for throughput; eval happens at block boundaries only)
@@ -112,15 +153,28 @@ class GossipConfig:
     hier_groups: int = 2        # topology='hierarchical': group count
     hier_period: int = 4        # ... global (cross-DCN) mix every N rounds
     choco_gamma: float = 1.0    # CHOCO-SGD consensus step size γ
+    # CHOCO theory wants γ scaled DOWN with the compressor's contraction
+    # factor δ (γ ≈ δ·spectral-gap terms); γ=1 is only safe because
+    # compression_ratio defaults to 1 (identity → exact D-SGD).  With a
+    # real compressor (ratio < 1 or qsgd) keep γ well below 1 — e.g.
+    # γ≈0.1·ratio — or the consensus step can diverge; the trainer warns
+    # on the risky combination.
     compression: str = "topk"   # CHOCO compressor: topk | randk | qsgd | none
     compression_ratio: float = 1.0
     # topk/randk: fraction of entries communicated (ratio=1 = identity;
-    # with γ=1 that reduces exactly to D-SGD — tested).  qsgd: ratio
-    # sets the quantization level count (ratio=1 → 256 levels, not the
-    # identity — use compression='none' for the exact reduction).
+    # with γ=1 that reduces exactly to D-SGD — tested; randk keeps a
+    # FIXED k = ceil(ratio·n) index set per round, so wire size is
+    # constant).  qsgd: ratio sets the quantization level count
+    # (ratio=1 → 256 levels, not the identity — use compression='none'
+    # for the exact reduction), unless qsgd_levels overrides it.
     # algorithm='choco' (Koloskova et al. 2019): workers gossip a
     # COMPRESSED difference Q(x_i − x̂_i) with error feedback, then take
     # the consensus step x_i += γ·((W x̂)_i − x̂_i).
+    qsgd_levels: int = 0
+    # Explicit QSGD level count (e.g. 16 = 4-bit range); 0 derives the
+    # count from compression_ratio (ratio·256).  Separate knob so the
+    # quantizer is not configured through the sparsifiers' fraction
+    # semantics; only valid with compression='qsgd'.
     comm_dtype: str | None = None
     # Communication compression for the consensus collective: e.g.
     # "bfloat16" narrows model shards BEFORE the cross-worker
@@ -206,13 +260,16 @@ def from_reference_args(args: Mapping[str, Any]) -> ExperimentConfig:
     if model_name in ("", "none"):
         model_name = default_model
 
+    if args.get("unequal"):
+        raise ValueError(
+            "unequal splits are not supported (the reference has none; "
+            "both its partitioner families produce equal-size shards)")
     data = DataConfig(
         dataset=dataset,
         iid=bool(_get("iid", True)),
         shards=int(_get("shards", 2)),
         num_users=int(_get("num_users", 8)),
         data_dir=args.get("data_dir"),
-        unequal=bool(_get("unequal", False)),
     )
     model = ModelConfig(
         model=model_name,

@@ -1,10 +1,12 @@
 from dopt.data.datasets import Dataset, load_dataset
-from dopt.data.partition import iid_split, noniid_split, partition
-from dopt.data.pipeline import BatchPlan, eval_batches, make_batch_plan, gather_batches
+from dopt.data.partition import holdout_split, iid_split, noniid_split, partition
+from dopt.data.pipeline import (BatchPlan, eval_batches, make_batch_plan,
+                                gather_batches, stacked_eval_batches)
 
 __all__ = [
     "Dataset",
     "load_dataset",
+    "holdout_split",
     "iid_split",
     "noniid_split",
     "partition",
@@ -12,4 +14,5 @@ __all__ = [
     "eval_batches",
     "make_batch_plan",
     "gather_batches",
+    "stacked_eval_batches",
 ]

@@ -66,6 +66,57 @@ def noniid_split(
     return out
 
 
+def holdout_split(
+    index_matrix: np.ndarray,
+    *,
+    fraction: float = 0.1,
+    mode: str = "deterministic",
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-worker local train/val holdout (the reference's
+    ``train_val_test``): ``val_size = max(int(L * fraction), 1)`` samples
+    of each worker's shard become local validation, the rest is the
+    training set.
+
+    mode='deterministic' takes the FIRST ``val_size`` indices of the
+    (sorted) shard — P1's ``idxs_train = idxs[val_size:]`` /
+    ``idxs_test = idxs[:val_size]`` (``Decentralized Optimization/src/
+    clients.py:25-28``).  mode='random' draws the val set without
+    replacement from a per-worker seeded stream — P2's
+    ``np.random.choice(list(idxs), val_size)`` (``Distributed
+    Optimization/src/clients.py:20-22``; the reference uses the global
+    numpy RNG seeded by ``setup_seed`` — here the stream is keyed by
+    (seed, worker) so the split is independent of construction order).
+
+    Returns ``(train_matrix [W, L - val_size], val_matrix [W, val_size])``;
+    rows stay sorted, and every worker's split has identical shape (the
+    input rows are equal length by construction).
+    """
+    if not 0.0 < fraction < 1.0:
+        raise ValueError(f"holdout fraction must be in (0, 1), got {fraction}")
+    if mode not in ("deterministic", "random"):
+        raise ValueError(
+            f"unknown holdout_mode {mode!r}; one of deterministic|random")
+    w, l = index_matrix.shape
+    val_size = max(int(l * fraction), 1)
+    if val_size >= l:
+        raise ValueError(
+            f"holdout of {val_size} samples leaves no training data "
+            f"(shard length {l})")
+    if mode == "deterministic":
+        return index_matrix[:, val_size:].copy(), index_matrix[:, :val_size].copy()
+    train = np.empty((w, l - val_size), dtype=index_matrix.dtype)
+    val = np.empty((w, val_size), dtype=index_matrix.dtype)
+    for i in range(w):
+        rng = np.random.default_rng(np.random.SeedSequence([seed, 77_000 + i]))
+        pos = rng.choice(l, val_size, replace=False)
+        mask = np.zeros(l, dtype=bool)
+        mask[pos] = True
+        val[i] = np.sort(index_matrix[i][mask])
+        train[i] = np.sort(index_matrix[i][~mask])
+    return train, val
+
+
 def partition(
     labels: np.ndarray,
     num_users: int,

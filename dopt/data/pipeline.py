@@ -134,6 +134,26 @@ def gather_batches(
     return bx, by, plan.weight
 
 
+def stacked_eval_batches(
+    index_matrix: np.ndarray, *, batch_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-worker static-shape eval stacks over index rows: [W, S, B]
+    gather indices + 0/1 wraparound-padding weights.  Used for the
+    local-validation holdout eval (the reference's per-client val
+    loader) and the per-client train-split eval
+    (``avg_trainig_calculator``)."""
+    w, l = index_matrix.shape
+    bs = min(batch_size, l)
+    steps = -(-l // bs)
+    pad = steps * bs - l
+    idx = (index_matrix if pad == 0
+           else np.concatenate([index_matrix, index_matrix[:, :pad]], axis=1))
+    weight = np.concatenate(
+        [np.ones((w, l), np.float32), np.zeros((w, pad), np.float32)], axis=1)
+    return (idx.reshape(w, steps, bs).astype(np.int32),
+            weight.reshape(w, steps, bs))
+
+
 def eval_batches(
     x: np.ndarray, y: np.ndarray, *, batch_size: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

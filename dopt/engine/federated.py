@@ -38,8 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from dopt.config import ExperimentConfig
-from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
-from dopt.engine.local import make_evaluator, make_stacked_local_update
+from dopt.data import (eval_batches, load_dataset, make_batch_plan,
+                       partition, stacked_eval_batches)
+from dopt.engine.local import (make_evaluator, make_stacked_local_update,
+                               make_stacked_local_update_epochs,
+                               prepare_holdout, validate_optimizer)
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
@@ -71,10 +74,17 @@ class FederatedTrainer:
         from dopt.engine.gossip import _reject_sequence_model
 
         _reject_sequence_model(cfg)
+        validate_optimizer(cfg)
         self.cfg = cfg
         self.eval_train = eval_train
         self.round = 0
         self.history = History(cfg.name)
+        # Per-epoch per-client rows (only filled when the local holdout
+        # is on): the reference's Client.history (P1 clients.py:50:
+        # {global_round, epoch, train_loss, train_acc, val_acc,
+        # val_loss}), plus a 'worker' column; sampled clients only, like
+        # the reference (only sampled clients run update_weights).
+        self.client_history = History(cfg.name + "-clients")
         self.timers = PhaseTimers()
 
         w = cfg.data.num_users
@@ -91,23 +101,24 @@ class FederatedTrainer:
             self.dataset.train_y, w, iid=cfg.data.iid,
             shards_per_user=cfg.data.shards, seed=cfg.seed,
         )
+        # Local train/val holdout (reference train_val_test, P1
+        # clients.py:16-34): training and the avg_trainig_calculator
+        # train-eval run on the 90% sub-shard; every local epoch
+        # evaluates the client's own val split (the first 10%).
+        self._holdout, self._train_matrix, self._val = prepare_holdout(
+            cfg, self.index_matrix, self.mesh, batch_size=f.local_bs)
         self._train_x = jnp.asarray(self.dataset.train_x)
         self._train_y = jnp.asarray(self.dataset.train_y)
         ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
                                   batch_size=max(f.local_bs, 256))
         self._eval = (jnp.asarray(ex), jnp.asarray(ey), jnp.asarray(ew))
         # Static per-worker train-eval stacks (sequential order) for the
-        # avg_trainig_calculator metric.
-        l = self.index_matrix.shape[1]
-        bs = min(max(f.local_bs, 256), l)
-        steps = -(-l // bs)
-        pad = steps * bs - l
-        ti = np.concatenate([self.index_matrix,
-                             self.index_matrix[:, :pad]], axis=1)
-        self._train_eval_idx = jnp.asarray(ti.reshape(w, steps, bs))
-        tw = np.concatenate([np.ones((w, l), np.float32),
-                             np.zeros((w, pad), np.float32)], axis=1)
-        self._train_eval_w = jnp.asarray(tw.reshape(w, steps, bs))
+        # avg_trainig_calculator metric (inference("train") — the TRAIN
+        # sub-shard when the holdout is on).
+        ti, tw = stacked_eval_batches(self._train_matrix,
+                                      batch_size=max(f.local_bs, 256))
+        self._train_eval_idx = jnp.asarray(ti)
+        self._train_eval_w = jnp.asarray(tw)
 
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
@@ -116,6 +127,10 @@ class FederatedTrainer:
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))
         theta0 = self.model.init(key, dummy)["params"]
+        # param_dtype: storage dtype of theta + the stacked worker state
+        # (bf16 halves HBM + collective bytes; f32 is the parity mode).
+        pdt = jnp.dtype(cfg.model.param_dtype)
+        theta0 = jax.tree.map(lambda x: x.astype(pdt), theta0)
         self.param_count = count_params(theta0)
         self.theta = jax.device_get(theta0)  # global model (replicated)
         stacked = jax.device_get(broadcast_to_workers(theta0, w))
@@ -135,13 +150,24 @@ class FederatedTrainer:
             if f.algorithm == "scaffold" else None
         )
 
+        local_algorithm = {"fedavg": "sgd", "fedprox": "fedprox",
+                           "fedadmm": "fedadmm", "scaffold": "scaffold"}[f.algorithm]
         local = make_stacked_local_update(
             self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
-            algorithm={"fedavg": "sgd", "fedprox": "fedprox",
-                       "fedadmm": "fedadmm", "scaffold": "scaffold"}[f.algorithm],
-            rho=cfg.optim.rho,
+            algorithm=local_algorithm,
+            rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
             update_impl="pallas" if cfg.optim.fused_update else "jnp",
         )
+        local_epochs = (
+            make_stacked_local_update_epochs(
+                self.model.apply, lr=cfg.optim.lr,
+                momentum=cfg.optim.momentum, algorithm=local_algorithm,
+                rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
+                update_impl="pallas" if cfg.optim.fused_update else "jnp")
+            if self._holdout else None
+        )
+        use_holdout = self._holdout
+        local_ep_n = f.local_ep
         global_eval = make_evaluator(self.model.apply)
         algorithm = f.algorithm
         # comm_dtype applies on ANY mesh size (a 1-device mesh still
@@ -154,21 +180,60 @@ class FederatedTrainer:
         momentum_coef = cfg.optim.momentum
         eval_train_flag = eval_train
 
-        def algo_step(theta, start, mom_in, duals_in, c_global, bx, by, bw):
-            """Local update + companion-state refresh on however many
-            lanes the inputs carry (all N for the full-width path, the m
-            sampled for the compact path).  Returns (p_t, m_t, losses,
-            accs, sub_new) where sub_new is the updated companion state
-            for THESE lanes (ADMM duals after ascent / SCAFFOLD controls
-            after the option-II refresh; unchanged for fedavg/fedprox).
-            The caller masks or scatters sub_new back into the
-            worker-stacked state and forms the server-control update."""
+        def run_local(start, mom_in, idx, bw, train_x, train_y, vidx, vw,
+                      theta=None, alpha=None):
+            """Dispatch the local-training phase on however many lanes
+            the inputs carry: flat step scan over the shard (idiomatic)
+            or, with the holdout on, the reference's epoch loop with
+            per-epoch local-val eval.  Returns (p, m, losses, accs, em)
+            with losses/accs per-step [lanes, S] or per-epoch [lanes, E]
+            (``mean(axis=1)`` is the round metric either way) and em the
+            per-epoch history arrays ({} when the holdout is off)."""
+            if use_holdout:
+                lanes = idx.shape[0]
+                se = idx.shape[1] // local_ep_n
+                idx_e = idx.reshape(lanes, local_ep_n, se, idx.shape[2])
+                bw_e = bw.reshape(idx_e.shape)
+                args = (start, mom_in, idx_e, bw_e, train_x, train_y,
+                        vidx, vw)
+                if algorithm == "fedavg":
+                    p_t, m_t, em = local_epochs(*args)
+                elif algorithm == "fedprox":
+                    p_t, m_t, em = local_epochs(*args, theta)
+                else:
+                    p_t, m_t, em = local_epochs(*args, theta, alpha)
+                return p_t, m_t, em["train_loss"], em["train_acc"], em
+            bx = train_x[idx]
+            by = train_y[idx]
             if algorithm == "fedavg":
                 p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw)
-                sub_new = duals_in
             elif algorithm == "fedprox":
                 p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
                                                theta)
+            else:
+                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
+                                               theta, alpha)
+            return p_t, m_t, losses, accs, {}
+
+        def algo_step(theta, start, mom_in, duals_in, c_global, idx, bw,
+                      train_x, train_y, vidx, vw):
+            """Local update + companion-state refresh on however many
+            lanes the inputs carry (all N for the full-width path, the m
+            sampled for the compact path).  Returns (p_t, m_t, losses,
+            accs, sub_new, em) where sub_new is the updated companion
+            state for THESE lanes (ADMM duals after ascent / SCAFFOLD
+            controls after the option-II refresh; unchanged for
+            fedavg/fedprox).  The caller masks or scatters sub_new back
+            into the worker-stacked state and forms the server-control
+            update."""
+            if algorithm == "fedavg":
+                p_t, m_t, losses, accs, em = run_local(
+                    start, mom_in, idx, bw, train_x, train_y, vidx, vw)
+                sub_new = duals_in
+            elif algorithm == "fedprox":
+                p_t, m_t, losses, accs, em = run_local(
+                    start, mom_in, idx, bw, train_x, train_y, vidx, vw,
+                    theta=theta)
                 sub_new = duals_in
             elif algorithm == "scaffold":
                 # Sampled workers restart from theta with a FRESH momentum
@@ -177,8 +242,9 @@ class FederatedTrainer:
                 # refresh); effective step size lr/(1−μ) accounts for
                 # heavy-ball amplification of the displacement.
                 mom0 = jax.tree.map(jnp.zeros_like, mom_in)
-                p_t, m_t, losses, accs = local(start, mom0, bx, by, bw,
-                                               c_global, duals_in)
+                p_t, m_t, losses, accs, em = run_local(
+                    start, mom0, idx, bw, train_x, train_y, vidx, vw,
+                    theta=c_global, alpha=duals_in)
                 steps = bw.shape[1]
                 lr_eff = lr / max(1.0 - momentum_coef, 1e-8)
                 sub_new = jax.vmap(
@@ -187,13 +253,14 @@ class FederatedTrainer:
                     in_axes=(0, 0),
                 )(duals_in, p_t)
             else:
-                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
-                                               theta, duals_in)
+                p_t, m_t, losses, accs, em = run_local(
+                    start, mom_in, idx, bw, train_x, train_y, vidx, vw,
+                    theta=theta, alpha=duals_in)
                 sub_new = jax.vmap(
                     lambda a, p: admm_dual_ascent(a, p, theta, rho),
                     in_axes=(0, 0),
                 )(duals_in, p_t)
-            return p_t, m_t, losses, accs, sub_new
+            return p_t, m_t, losses, accs, sub_new, em
 
         def control_delta(c_global, sub_new, sub_old):
             """SCAFFOLD server control: c ← c + (1/N)·Σ_{i∈S}(c_i⁺ − c_i);
@@ -221,13 +288,12 @@ class FederatedTrainer:
                     evalm, trainm)
 
         def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
-                     train_x, train_y, ex, ey, ew, tidx, tweight):
-            bx = train_x[idx]
-            by = train_y[idx]
+                     train_x, train_y, ex, ey, ew, tidx, tweight, vidx, vw):
             theta_b = broadcast_to_workers(theta, w)
             start = _where_mask(mask, theta_b, params)
-            p_t, m_t, losses, accs, sub_new = algo_step(
-                theta, start, mom, duals, c_global, bx, by, bweight)
+            p_t, m_t, losses, accs, sub_new, em = algo_step(
+                theta, start, mom, duals, c_global, idx, bweight,
+                train_x, train_y, vidx, vw)
             if algorithm in ("scaffold", "fedadmm"):
                 new_duals = _where_mask(mask, sub_new, duals)
             else:
@@ -243,9 +309,9 @@ class FederatedTrainer:
             new_theta = masked_average(new_p, mask, mesh=agg_mesh,
                                        comm_dtype=agg_comm)
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
-            return finish(new_theta, new_p, new_m, new_duals, new_c,
-                          local_loss, train_x, train_y, ex, ey, ew, tidx,
-                          tweight)
+            return (*finish(new_theta, new_p, new_m, new_duals, new_c,
+                            local_loss, train_x, train_y, ex, ey, ew, tidx,
+                            tweight), em)
 
         # Per-worker train-split eval: every input has a worker axis.
         stacked_eval_perworker = jax.vmap(
@@ -261,7 +327,7 @@ class FederatedTrainer:
 
         def compact_round_fn(theta, params, mom, duals, c_global, sel,
                              idx_sel, bw_sel, train_x, train_y, ex, ey, ew,
-                             tidx, tweight):
+                             tidx, tweight, vidx, vw):
             """Compact-sampling fast path: only the m = len(sel) sampled
             workers' lanes are trained ([m, ...] gather → local update →
             scatter-back), instead of all N lanes computing and the mask
@@ -269,13 +335,11 @@ class FederatedTrainer:
             float summation order (the sampled average sums m terms
             directly rather than N mask-weighted ones)."""
             m = sel.shape[0]
-            bx = train_x[idx_sel]
-            by = train_y[idx_sel]
             start = broadcast_to_workers(theta, m)
             duals_sel = _take(duals, sel)
-            p_t, m_t, losses, accs, sub_new = algo_step(
+            p_t, m_t, losses, accs, sub_new, em = algo_step(
                 theta, start, _take(mom, sel), duals_sel, c_global,
-                bx, by, bw_sel)
+                idx_sel, bw_sel, train_x, train_y, vidx[sel], vw[sel])
             if algorithm in ("scaffold", "fedadmm"):
                 new_duals = _scatter(duals, sel, sub_new)
             else:
@@ -285,9 +349,9 @@ class FederatedTrainer:
             new_p = _scatter(params, sel, p_t)
             new_m = mom if algorithm == "scaffold" else _scatter(mom, sel, m_t)
             new_theta = jax.tree.map(lambda x: x.mean(axis=0), p_t)
-            return finish(new_theta, new_p, new_m, new_duals, new_c,
-                          losses.mean(), train_x, train_y, ex, ey, ew, tidx,
-                          tweight)
+            return (*finish(new_theta, new_p, new_m, new_duals, new_c,
+                            losses.mean(), train_x, train_y, ex, ey, ew, tidx,
+                            tweight), em)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
@@ -300,19 +364,21 @@ class FederatedTrainer:
             identical to the per-round path's."""
 
             def block_fn(theta, params, mom, duals, c_global, gates, idxs,
-                         bws, train_x, train_y, ex, ey, ew, tidx, tweight):
+                         bws, train_x, train_y, ex, ey, ew, tidx, tweight,
+                         vidx, vw):
                 def body(carry, xs):
                     th, p, m, d, c = carry
                     gate, idx, bw = xs
-                    th, p, m, d, c, ll, evalm, trainm = one_round(
+                    th, p, m, d, c, ll, evalm, trainm, em = one_round(
                         th, p, m, d, c, gate, idx, bw,
-                        train_x, train_y, ex, ey, ew, tidx, tweight)
-                    return (th, p, m, d, c), (ll, evalm, trainm)
+                        train_x, train_y, ex, ey, ew, tidx, tweight,
+                        vidx, vw)
+                    return (th, p, m, d, c), (ll, evalm, trainm, em)
 
-                carry, (lls, evalms, trainms) = jax.lax.scan(
+                carry, (lls, evalms, trainms, ems) = jax.lax.scan(
                     body, (theta, params, mom, duals, c_global),
                     (gates, idxs, bws))
-                return (*carry, lls, evalms, trainms)
+                return (*carry, lls, evalms, trainms, ems)
 
             return jax.jit(block_fn, donate_argnums=(1, 2, 3))
 
@@ -385,7 +451,7 @@ class FederatedTrainer:
                 sels = [self._sample_indices(frac) for _ in ts]
                 plans = [
                     make_batch_plan(
-                        self.index_matrix, batch_size=f.local_bs,
+                        self._train_matrix, batch_size=f.local_bs,
                         local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
                         impl=cfg.data.plan_impl,
                         workers=sel if compact else None,
@@ -409,11 +475,11 @@ class FederatedTrainer:
             c_in = self.c_global if self.c_global is not None else {}
             fn = self._compact_block_fn if compact else self._block_fn
             (self.theta, self.params, self.momentum, new_duals, new_c, lls,
-             evalms, trainms) = self.timers.measure(
+             evalms, trainms, ems) = self.timers.measure(
                 "round_step", fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
                 gates, idx, bw, self._train_x, self._train_y, *self._eval,
-                self._train_eval_idx, self._train_eval_w,
+                self._train_eval_idx, self._train_eval_w, *self._val,
             )
             if self.duals is not None:
                 self.duals = new_duals
@@ -424,6 +490,7 @@ class FederatedTrainer:
             loss_sum = np.asarray(evalms["loss_sum"])
             t_loss = np.asarray(trainms["loss_mean"])
             t_acc = np.asarray(trainms["acc"])
+            ems = {k_: np.asarray(v) for k_, v in ems.items()}
             for j, t in enumerate(ts):
                 self.history.append(
                     round=t,
@@ -433,6 +500,11 @@ class FederatedTrainer:
                     train_acc=float(t_acc[j].mean()),
                     local_loss=float(lls[j]),
                 )
+                if self._holdout:
+                    em_j = {k_: v[j] for k_, v in ems.items()}
+                    if not compact:
+                        em_j = {k_: v[sels[j]] for k_, v in em_j.items()}
+                    self._append_client_rows(t, em_j, sels[j])
                 self.round += 1
             done += k
         self.total_time = time.time() - t0
@@ -460,7 +532,7 @@ class FederatedTrainer:
                 # host cost O(m), and the RNG is keyed by true worker id
                 # so the plans are bit-identical to the full plan's rows.
                 plan = make_batch_plan(
-                    self.index_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
+                    self._train_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                     workers=sel if compact else None,
                 )
@@ -477,12 +549,12 @@ class FederatedTrainer:
             step_fn = self._compact_fn if compact else self._round_fn
             gate = jnp.asarray(sel) if compact else jnp.asarray(mask)
             (self.theta, self.params, self.momentum, new_duals, new_c,
-             local_loss, evalm, trainm) = self.timers.measure(
+             local_loss, evalm, trainm, em) = self.timers.measure(
                 "round_step", step_fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
                 gate, idx, bweight,
                 self._train_x, self._train_y, *self._eval,
-                self._train_eval_idx, self._train_eval_w,
+                self._train_eval_idx, self._train_eval_w, *self._val,
             )
             if self.duals is not None:
                 self.duals = new_duals
@@ -496,9 +568,29 @@ class FederatedTrainer:
                 train_acc=float(np.mean(np.asarray(trainm["acc"]))),
                 local_loss=float(local_loss),
             )
+            if self._holdout:
+                em_np = {k_: np.asarray(v) for k_, v in em.items()}
+                if not compact:
+                    em_np = {k_: v[sel] for k_, v in em_np.items()}
+                self._append_client_rows(t, em_np, sel)
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
+
+    def _append_client_rows(self, t: int, em: dict, workers) -> None:
+        """Per-epoch per-client history rows (P1 Client.history schema,
+        clients.py:50: {global_round, epoch, train_loss, train_acc,
+        val_acc, val_loss} with val_loss in P1's summed-batch-loss
+        flavour), one row per (sampled client, epoch)."""
+        tl, ta = em["train_loss"], em["train_acc"]
+        va, vl = em["val_acc"], em["val_loss_sum"]
+        for j, wid in enumerate(workers):
+            for e in range(tl.shape[1]):
+                self.client_history.append(
+                    global_round=t, epoch=e, worker=int(wid),
+                    train_loss=float(tl[j, e]), train_acc=float(ta[j, e]),
+                    val_acc=float(va[j, e]), val_loss=float(vl[j, e]),
+                )
 
     def save(self, path) -> None:
         """Checkpoint (theta, stacked params, momentum, duals, round,
@@ -521,6 +613,7 @@ class FederatedTrainer:
             meta={"round": self.round, "name": self.cfg.name,
                   "algorithm": self.cfg.federated.algorithm,
                   "history": self.history.rows,
+                  "client_history": self.client_history.rows,
                   "sample_rng_state": self._sample_rng.bit_generator.state},
         )
 
@@ -552,6 +645,7 @@ class FederatedTrainer:
             self.c_global = arrays["c_global"]
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
+        self.client_history.rows = list(meta.get("client_history", []))
         if meta.get("sample_rng_state"):
             self._sample_rng.bit_generator.state = meta["sample_rng_state"]
 

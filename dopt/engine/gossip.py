@@ -33,14 +33,17 @@ import numpy as np
 from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
 from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update,
-                               make_stacked_local_update_gather)
+                               make_stacked_local_update_epochs,
+                               make_stacked_local_update_gather,
+                               prepare_holdout, validate_optimizer)
 from dopt.models import build_model, count_params
 from dopt.parallel.collectives import (broadcast_to_workers, mix_dense,
-                                       mix_power, where_mask)
+                                       mix_shifts, where_mask)
 from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
                                 worker_axes, worker_sharding)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
-                           repair_for_dropout)
+                           coeffs_for_matrix, repair_for_dropout,
+                           schedule_shift_decomposition)
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
@@ -106,6 +109,7 @@ class GossipTrainer:
                 "dsgd|nocons|centralized|fedlcon|gossip|choco"
             )
         _reject_sequence_model(cfg)
+        validate_optimizer(cfg)
         if g.algorithm == "centralized":
             # The reference's Centeralized mutates the SHARED args object
             # (simulators.py:171-173) — we derive a new frozen config.
@@ -118,6 +122,12 @@ class GossipTrainer:
         self.eval_every = eval_every
         self.round = 0
         self.history = History(cfg.name)
+        # Per-epoch per-worker rows (only filled when the local holdout
+        # is on): the reference's Client.history
+        # (P2 clients.py:52-57 {iter, train_loss, train_acc, val_acc,
+        # val_loss}), plus a 'worker' column since all clients share one
+        # engine.
+        self.client_history = History(cfg.name + "-clients")
         self.timers = PhaseTimers()
 
         w = cfg.data.num_users
@@ -134,6 +144,11 @@ class GossipTrainer:
             self.dataset.train_y, w, iid=cfg.data.iid,
             shards_per_user=cfg.data.shards, seed=cfg.seed,
         )
+        # Local train/val holdout (reference train_val_test, P2
+        # clients.py:19-32): training runs on the 90% sub-shard only and
+        # every local epoch evaluates the worker's own val split.
+        self._holdout, self._train_matrix, self._val = prepare_holdout(
+            cfg, self.index_matrix, self.mesh, batch_size=g.local_bs)
         self._train_x = jnp.asarray(self.dataset.train_x)
         self._train_y = jnp.asarray(self.dataset.train_y)
         ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
@@ -149,6 +164,10 @@ class GossipTrainer:
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))
         params0 = self.model.init(key, dummy)["params"]
+        # param_dtype: storage dtype of the stacked worker state (bf16
+        # halves HBM + collective bytes; f32 is the parity mode).
+        pdt = jnp.dtype(cfg.model.param_dtype)
+        params0 = jax.tree.map(lambda x: x.astype(pdt), params0)
         self.param_count = count_params(params0)
         stacked = broadcast_to_workers(params0, w)
         self.params = shard_worker_tree(jax.device_get(stacked), self.mesh)
@@ -181,10 +200,20 @@ class GossipTrainer:
 
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
+        l2 = cfg.optim.weight_decay
         local = make_stacked_local_update(
             self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
-            algorithm="sgd", update_impl=update_impl,
+            algorithm="sgd", l2=l2, update_impl=update_impl,
         )
+        local_epochs = (
+            make_stacked_local_update_epochs(
+                self.model.apply, lr=cfg.optim.lr,
+                momentum=cfg.optim.momentum, algorithm="sgd", l2=l2,
+                update_impl=update_impl)
+            if self._holdout else None
+        )
+        use_holdout = self._holdout
+        local_ep_n = g.local_ep
         evaluator = make_stacked_evaluator(self.model.apply)
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
@@ -192,10 +221,78 @@ class GossipTrainer:
         mesh = self.mesh
         comm_dtype = jnp.dtype(g.comm_dtype) if g.comm_dtype else None
 
+        # Consensus collective selection (GossipConfig.comm_impl): the
+        # ppermute shift path replaces the reference's Neighbors()
+        # state-dict passing (simulators.py:91-97) with O(k·|θ|) bytes of
+        # ICI neighbor traffic per round instead of the dense path's
+        # O(n·|θ|) all_gather.  The shift SET is static (compiled); the
+        # per-round coefficients are data, so time-varying schedules and
+        # dropout-repaired matrices reuse one compiled step.
+        if g.comm_impl not in ("auto", "dense", "shift"):
+            raise ValueError(
+                f"unknown comm_impl {g.comm_impl!r}; one of auto|dense|shift")
+        self._shift_ids: tuple[int, ...] | None = None
+        if g.comm_impl != "dense" and self.mixing is not None and (do_mix or is_choco):
+            flat_1d = len(mesh.axis_names) == 1
+            one_worker_per_device = mesh.size == w
+            extra = (0,) if has_dropout else ()
+            # auto: only take the shift path when it beats all_gather
+            # comfortably; explicit 'shift' honors any decomposable set.
+            limit = (None if g.comm_impl == "shift"
+                     else max(2, w // 2) + (1 if has_dropout else 0))
+            ids = (schedule_shift_decomposition(self.mixing, max_shifts=limit,
+                                                extra_shifts=extra)
+                   if (flat_1d and one_worker_per_device) else None)
+            if ids is not None:
+                self._shift_ids = ids
+            elif g.comm_impl == "shift":
+                raise ValueError(
+                    "comm_impl='shift' requires workers == mesh devices on a "
+                    f"flat 1-D mesh (workers={w}, mesh={mesh.shape}) and a "
+                    "mixing schedule that decomposes into circulant shifts "
+                    f"(topology={g.topology!r})")
+        elif g.comm_impl == "shift":
+            raise ValueError(
+                "comm_impl='shift' needs a mixing-schedule algorithm "
+                f"(dsgd|fedlcon|choco), not {g.algorithm!r}")
+
+        shift_ids = self._shift_ids
+
+        def mix_once(x, arg):
+            """One consensus sweep; ``arg`` is the [n, n] matrix (dense)
+            or the [k, n] coefficient table (shift) for the round."""
+            if shift_ids is not None:
+                return mix_shifts(x, shift_ids, arg, mesh, comm_dtype)
+            return mix_dense(x, arg, mesh, comm_dtype)
+
+        def mix_consensus(x, arg):
+            """eps sweeps (FedLCon, with the stale-accumulation bug
+            fixed: each sweep reads the previous sweep's output)."""
+            if eps == 1:
+                return mix_once(x, arg)
+
+            def body(c, _):
+                return mix_once(c, arg), None
+
+            out, _ = jax.lax.scan(body, x, None, length=eps)
+            return out
+
         if is_choco:
             from dopt.ops.compression import make_compressor
 
-            compressor = make_compressor(g.compression, g.compression_ratio)
+            compressor = make_compressor(g.compression, g.compression_ratio,
+                                         qsgd_levels=g.qsgd_levels)
+            real_compression = (g.compression == "qsgd"
+                                or (g.compression in ("topk", "randk")
+                                    and g.compression_ratio < 1.0))
+            if g.choco_gamma >= 1.0 and real_compression:
+                import warnings
+
+                warnings.warn(
+                    "choco_gamma >= 1 with a real compressor can diverge: "
+                    "CHOCO-SGD theory scales γ down with the compressor's "
+                    "contraction factor (try γ ≈ 0.1·compression_ratio)",
+                    stacklevel=2)
             choco_gamma = g.choco_gamma
             choco_key = jax.random.key(cfg.seed ^ 0x0C0C0)
 
@@ -212,7 +309,7 @@ class GossipTrainer:
                 # Dead workers send nothing: their public copy freezes.
                 q = where_mask(alive, q, jax.tree.map(jnp.zeros_like, q))
             x_hat = jax.tree.map(lambda a, b: a + b, x_hat, q)
-            mixed = mix_dense(x_hat, w_matrix, mesh, comm_dtype)
+            mixed = mix_once(x_hat, w_matrix)
             new_p = jax.tree.map(
                 lambda p, mx, xh: p + (choco_gamma * (mx - xh)).astype(p.dtype),
                 params, mixed, x_hat)
@@ -230,28 +327,46 @@ class GossipTrainer:
             return ((losses.mean(axis=1) * alive).sum() / denom,
                     (accs.mean(axis=1) * alive).sum() / denom)
 
+        def local_phase(params, mom, idx, bweight, train_x, train_y,
+                        vidx, vw):
+            """The per-round local-training phase: flat step scan on the
+            full shard, or (holdout mode) the reference's epoch loop with
+            per-epoch local-val eval.  Returns (p, m, losses, accs, em)
+            where losses/accs are per-step [W, S] or per-epoch [W, E] —
+            either way ``mean(axis=1)`` is the round's train metric —
+            and em carries the per-epoch history arrays ({} when off)."""
+            if use_holdout:
+                se = idx.shape[1] // local_ep_n
+                idx_e = idx.reshape(idx.shape[0], local_ep_n, se, idx.shape[2])
+                bw_e = bweight.reshape(idx_e.shape)
+                p_t, m_t, em = local_epochs(params, mom, idx_e, bw_e,
+                                            train_x, train_y, vidx, vw)
+                return p_t, m_t, em["train_loss"], em["train_acc"], em
+            bx = train_x[idx]
+            by = train_y[idx]
+            p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
+            return p_t, m_t, losses, accs, {}
+
         def round_fn(params, mom, x_hat, w_matrix, alive, t, idx, bweight,
-                     train_x, train_y, ex, ey, ew, do_eval):
+                     train_x, train_y, ex, ey, ew, vidx, vw, do_eval):
             if is_choco:
                 params, x_hat = choco_mix(params, x_hat, w_matrix, alive, t)
             elif do_mix:
-                params = mix_power(params, w_matrix, eps=eps, mesh=mesh,
-                                   comm_dtype=comm_dtype)
+                params = mix_consensus(params, w_matrix)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
                 zeros_eval,
             )
-            bx = train_x[idx]
-            by = train_y[idx]
-            p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
+            p_t, m_t, losses, accs, em = local_phase(
+                params, mom, idx, bweight, train_x, train_y, vidx, vw)
             if has_dropout:
                 # Dead workers skip the local update (their lanes compute
                 # and are discarded — static shapes).
                 p_t = where_mask(alive, p_t, params)
                 m_t = where_mask(alive, m_t, mom)
             tl, ta = train_metrics(losses, accs, alive)
-            return p_t, m_t, x_hat, tl, ta, evalm
+            return p_t, m_t, x_hat, tl, ta, evalm, em
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
@@ -261,12 +376,12 @@ class GossipTrainer:
         self._do_mix, self._eps = do_mix, eps
         self._local_gather = make_stacked_local_update_gather(
             self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
-            algorithm="sgd", update_impl=update_impl,
+            algorithm="sgd", l2=l2, update_impl=update_impl,
         )
         local_g, ev = self._local_gather, self._evaluator
 
         def block_fn(params, mom, x_hat, w_mats, alive, ts, idx, bw, is_eval,
-                     train_x, train_y, ex, ey, ew):
+                     train_x, train_y, ex, ey, ew, vidx, vw):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -281,22 +396,26 @@ class GossipTrainer:
                 if is_choco:
                     p, xh = choco_mix(p, xh, w_t, alive_t, t_t)
                 elif do_mix:
-                    p = mix_power(p, w_t, eps=eps, mesh=mesh,
-                                  comm_dtype=comm_dtype)
+                    p = mix_consensus(p, w_t)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
-                p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t,
-                                                 train_x, train_y)
+                if use_holdout:
+                    p_t, m_t, losses, accs, em = local_phase(
+                        p, m, idx_t, bw_t, train_x, train_y, vidx, vw)
+                else:
+                    p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t,
+                                                     train_x, train_y)
+                    em = {}
                 if has_dropout:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                return (p_t, m_t, xh), (tl, ta, evalm)
+                return (p_t, m_t, xh), (tl, ta, evalm, em)
 
-            (params, mom, x_hat), (tl, ta, evalms) = jax.lax.scan(
+            (params, mom, x_hat), (tl, ta, evalms, ems) = jax.lax.scan(
                 body, (params, mom, x_hat), (w_mats, alive, ts, idx, bw,
                                              is_eval)
             )
-            return params, mom, x_hat, tl, ta, evalms
+            return params, mom, x_hat, tl, ta, evalms, ems
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
 
@@ -316,7 +435,7 @@ class GossipTrainer:
                 w_mats = np.stack([p[0] for p in pairs])
                 alive = np.stack([p[1] for p in pairs])
                 plans = [
-                    make_batch_plan(self.index_matrix, batch_size=g.local_bs,
+                    make_batch_plan(self._train_matrix, batch_size=g.local_bs,
                                     local_ep=g.local_ep, seed=cfg.seed,
                                     round_idx=t, impl=cfg.data.plan_impl)
                     for t in ts
@@ -328,17 +447,18 @@ class GossipTrainer:
             is_eval = np.asarray(
                 [(t % self.eval_every) == 0 for t in ts], dtype=bool
             )
-            (self.params, self.momentum, self.x_hat, tl, ta,
-             evalms) = self.timers.measure(
+            (self.params, self.momentum, self.x_hat, tl, ta, evalms,
+             ems) = self.timers.measure(
                 "round_step", self._block_fn,
                 self.params, self.momentum, self.x_hat, w_mats, alive,
                 jnp.asarray(ts, jnp.int32), idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
-                *self._eval,
+                *self._eval, *self._val,
             )
             tl, ta = np.asarray(tl), np.asarray(ta)
             acc = np.asarray(evalms["acc"])
             loss_mean = np.asarray(evalms["loss_mean"])
+            ems = {k_: np.asarray(v) for k_, v in ems.items()}
             for j, t in enumerate(ts):
                 row = {
                     "round": t,
@@ -349,12 +469,30 @@ class GossipTrainer:
                     row["avg_test_acc"] = float(acc[j].mean())
                     row["avg_test_loss"] = float(loss_mean[j].mean())
                 self.history.append(**row)
+                if self._holdout:
+                    self._append_client_rows(
+                        t, {k_: v[j] for k_, v in ems.items()})
                 self.round += 1
             done += k
         self.total_time = time.time() - t0
         return self.history
 
     # ------------------------------------------------------------------
+    def _append_client_rows(self, t: int, em: dict) -> None:
+        """Per-epoch per-worker history rows (P2 Client.history schema,
+        clients.py:52-57: {iter, train_loss, train_acc, val_acc,
+        val_loss} with val_loss in P2's mean-per-batch flavour), one row
+        per (worker, epoch)."""
+        tl, ta = em["train_loss"], em["train_acc"]
+        va, vl = em["val_acc"], em["val_loss_mean"]
+        for i in range(self.num_workers):
+            for e in range(tl.shape[1]):
+                self.client_history.append(
+                    round=t, iter=e, worker=i,
+                    train_loss=float(tl[i, e]), train_acc=float(ta[i, e]),
+                    val_acc=float(va[i, e]), val_loss=float(vl[i, e]),
+                )
+
     def _matrix_for_round(self, t: int) -> np.ndarray:
         g = self.cfg.gossip
         if g.algorithm == "gossip":
@@ -374,12 +512,19 @@ class GossipTrainer:
                 >= g.dropout).astype(np.float32)
 
     def _round_inputs(self, t: int) -> tuple[np.ndarray, np.ndarray]:
-        """(mixing matrix, alive mask) for round t, with the matrix
-        repaired for any failed workers."""
+        """(mixing argument, alive mask) for round t, with the matrix
+        repaired for any failed workers.  The mixing argument is the
+        [n, n] matrix on the dense path or its [k, n] circulant
+        coefficient table on the shift/ppermute path (same math:
+        ``coeffs_for_matrix`` raises if the matrix ever leaves the
+        compiled shift set, so the two paths can never silently
+        diverge)."""
         w_t = self._matrix_for_round(t)
         alive = self._alive_for_round()
         if alive.min() < 1.0:
             w_t = repair_for_dropout(w_t, alive)
+        if self._shift_ids is not None:
+            return coeffs_for_matrix(w_t, self._shift_ids), alive
         return w_t.astype(np.float32), alive
 
     def run(self, rounds: int | None = None, eps: int | None = None,
@@ -403,18 +548,19 @@ class GossipTrainer:
             with self.timers.phase("host_batch_plan"):
                 w_t, alive = self._round_inputs(t)
                 plan = make_batch_plan(
-                    self.index_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
+                    self._train_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                 )
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
             (self.params, self.momentum, self.x_hat, train_loss, train_acc,
-             evalm) = self.timers.measure(
+             evalm, em) = self.timers.measure(
                 "round_step", self._round_fn,
                 self.params, self.momentum, self.x_hat, w_t, alive,
                 jnp.asarray(t, jnp.int32), idx, bweight,
-                self._train_x, self._train_y, *self._eval, do_eval,
+                self._train_x, self._train_y, *self._eval, *self._val,
+                do_eval,
             )
             row = {
                 "round": t,
@@ -425,6 +571,9 @@ class GossipTrainer:
                 row["avg_test_acc"] = float(np.mean(np.asarray(evalm["acc"])))
                 row["avg_test_loss"] = float(np.mean(np.asarray(evalm["loss_mean"])))
             self.history.append(**row)
+            if self._holdout:
+                self._append_client_rows(
+                    t, {k_: np.asarray(v) for k_, v in em.items()})
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
@@ -445,6 +594,7 @@ class GossipTrainer:
             meta={"round": self.round, "name": self.cfg.name,
                   "algorithm": self.cfg.gossip.algorithm,
                   "history": self.history.rows,
+                  "client_history": self.client_history.rows,
                   "matching_rng_state": self._matching_rng.bit_generator.state,
                   "dropout_rng_state": self._dropout_rng.bit_generator.state},
         )
@@ -469,6 +619,7 @@ class GossipTrainer:
             self.x_hat = shard_worker_tree(arrays["x_hat"], self.mesh)
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
+        self.client_history.rows = list(meta.get("client_history", []))
         if meta.get("matching_rng_state"):
             self._matching_rng.bit_generator.state = meta["matching_rng_state"]
         if meta.get("dropout_rng_state"):

@@ -28,6 +28,46 @@ from dopt.optim import (SGDState, admm_grad_edit, prox_grad_edit,
                         scaffold_grad_edit, sgd_step)
 
 
+def validate_optimizer(cfg) -> None:
+    """Only 'sgd' exists (the reference's single optimizer,
+    clients.py:14); anything else fails loudly at trainer construction
+    rather than silently running SGD."""
+    if cfg.optim.optimizer.lower() != "sgd":
+        raise ValueError(
+            f"unknown optimizer {cfg.optim.optimizer!r}: only 'sgd' "
+            "exists (the reference's single optimizer, clients.py:14)")
+
+
+def prepare_holdout(cfg, index_matrix, mesh, *, batch_size):
+    """Shared trainer setup for the reference's local train/val holdout
+    (``train_val_test`` — P1 clients.py:16-34 / P2 clients.py:19-32).
+
+    Returns ``(use_holdout, train_matrix, (vidx_dev, vw_dev))``: the
+    training index matrix (the full shard when the holdout is off) and
+    per-worker local-val eval stacks placed with the worker axis sharded.
+    When off, the val stacks are [W, 1, 1] zero dummies so jitted round
+    signatures stay static either way — both engines rely on that
+    contract."""
+    import numpy as np
+
+    from dopt.data import holdout_split, stacked_eval_batches
+    from dopt.parallel.mesh import worker_sharding
+
+    w = index_matrix.shape[0]
+    use = cfg.data.local_holdout > 0.0
+    if use:
+        train_matrix, val_matrix = holdout_split(
+            index_matrix, fraction=cfg.data.local_holdout,
+            mode=cfg.data.holdout_mode, seed=cfg.seed)
+        vi, vw = stacked_eval_batches(val_matrix, batch_size=batch_size)
+    else:
+        train_matrix = index_matrix
+        vi = np.zeros((w, 1, 1), np.int32)
+        vw = np.zeros((w, 1, 1), np.float32)
+    sh = worker_sharding(mesh)
+    return use, train_matrix, (jax.device_put(vi, sh), jax.device_put(vw, sh))
+
+
 def _apply_update(p, m, g, *, lr, momentum, update_impl):
     """Dispatch the momentum-SGD update: 'jnp' (tree.map two-liner) or
     'pallas' (fused single-pass kernel, dopt.ops.fused_update)."""
@@ -190,6 +230,105 @@ def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
         lambda p, m, idx, bw, tx, ty, theta, alpha: fn(
             p, m, idx, bw, tx, ty, theta=theta, alpha=alpha),
         in_axes=(0, 0, 0, 0, None, None, None, 0),
+    )
+
+
+def make_local_update_epochs(
+    apply_fn: Callable,
+    *,
+    lr: float,
+    momentum: float,
+    algorithm: str = "sgd",
+    rho: float = 0.0,
+    l2: float = 0.0,
+    update_impl: str = "jnp",
+):
+    """Local update with the reference's EPOCH structure: an outer scan
+    over local epochs, each running its steps then evaluating the
+    worker's local validation holdout — ``Client.update_weights``'s
+    per-epoch ``inference`` + history row
+    (``Decentralized Optimization/src/clients.py:38-50`` /
+    ``Distributed Optimization/src/clients.py:37-57``).
+
+    Returns fn(params, mom, idx, bw, train_x, train_y, vidx, vw,
+    theta=None, alpha=None) -> (new_params, new_mom, em) where ``idx``/
+    ``bw`` are [E, S', B] epoch-major plans, ``vidx``/``vw`` the [Sv, Bv]
+    local-val eval stacks, and ``em`` maps per-epoch [E] arrays:
+
+    * train_loss — mean over the epoch's batches of the batch-mean loss
+      (``sum(train_loss)/len(train_loss)``, clients.py:47)
+    * train_acc  — epoch correct count / train-set size
+      (``train_acc += corr/total``, clients.py:44-45)
+    * val_acc / val_loss_sum / val_loss_mean — post-epoch local-val
+      metrics in both reference flavours (P1 ``inference`` sums batch
+      losses, P2 averages them).
+    """
+    if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
+        raise ValueError(f"unknown local algorithm {algorithm!r}")
+    core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
+                           algorithm=algorithm, rho=rho, l2=l2,
+                           update_impl=update_impl)
+    ev = make_evaluator(apply_fn)
+
+    def local_update(params, mom, idx, bw, train_x, train_y, vidx, vw,
+                     theta=None, alpha=None):
+        vx = train_x[vidx]
+        vy = train_y[vidx]
+
+        def epoch(carry, ep):
+            p, m = carry
+            ei, ew = ep
+
+            def step(c, b):
+                p_, m_ = c
+                i, w_ = b
+                p_, m_, loss, acc = core(p_, m_, train_x[i], train_y[i], w_,
+                                         theta, alpha)
+                return (p_, m_), (loss, acc * w_.sum(), w_.sum())
+
+            (p, m), (losses, corrects, counts) = jax.lax.scan(
+                step, (p, m), (ei, ew))
+            vm = ev(p, vx, vy, vw)
+            em = {
+                "train_loss": losses.mean(),
+                "train_acc": corrects.sum() / jnp.maximum(counts.sum(), 1.0),
+                "val_acc": vm["acc"],
+                "val_loss_sum": vm["loss_sum"],
+                "val_loss_mean": vm["loss_mean"],
+            }
+            return (p, m), em
+
+        (params, mom), em = jax.lax.scan(epoch, (params, mom), (idx, bw))
+        return params, mom, em
+
+    return local_update
+
+
+def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
+                                     algorithm="sgd", rho=0.0, l2=0.0,
+                                     update_impl="jnp"):
+    """vmap the epoch-structured update over the leading worker axis;
+    train arrays and theta broadcast, per-worker plans / val stacks /
+    ADMM duals stacked."""
+    fn = make_local_update_epochs(apply_fn, lr=lr, momentum=momentum,
+                                  algorithm=algorithm, rho=rho, l2=l2,
+                                  update_impl=update_impl)
+    if algorithm == "sgd":
+        return jax.vmap(
+            lambda p, m, idx, bw, tx, ty, vi, vw_: fn(p, m, idx, bw, tx, ty,
+                                                      vi, vw_),
+            in_axes=(0, 0, 0, 0, None, None, 0, 0),
+        )
+    if algorithm == "fedprox":
+        return jax.vmap(
+            lambda p, m, idx, bw, tx, ty, vi, vw_, theta: fn(
+                p, m, idx, bw, tx, ty, vi, vw_, theta=theta),
+            in_axes=(0, 0, 0, 0, None, None, 0, 0, None),
+        )
+    return jax.vmap(
+        lambda p, m, idx, bw, tx, ty, vi, vw_, theta, alpha: fn(
+            p, m, idx, bw, tx, ty, vi, vw_, theta=theta, alpha=alpha),
+        in_axes=(0, 0, 0, 0, None, None, 0, 0, None, 0),
     )
 
 

@@ -164,12 +164,13 @@ class OracleWorker:
     """
 
     def __init__(self, model: "nn.Module", *, lr: float, momentum: float,
-                 rho: float = 0.0, algorithm: str = "sgd"):
+                 rho: float = 0.0, algorithm: str = "sgd", l2: float = 0.0):
         assert HAVE_TORCH
         self.model = model
         self.optimizer = torch.optim.SGD(model.parameters(), lr=lr,
                                          momentum=momentum)
         self.rho = rho
+        self.l2 = l2  # explicit λ‖θ‖²/2 loss term (dopt l2_regulariser)
         self.algorithm = algorithm
         if algorithm == "fedadmm":
             self.alpha = {n: torch.zeros_like(p)
@@ -204,6 +205,9 @@ class OracleWorker:
             out = self.model(x)
             per = F.cross_entropy(out, y, reduction="none")
             loss = (per * w).sum() / w.sum().clamp(min=1.0)
+            if self.l2:
+                loss = loss + 0.5 * self.l2 * sum(
+                    (p ** 2).sum() for p in self.model.parameters())
             loss.backward()
             if self.algorithm in ("fedprox", "fedadmm"):
                 for n, p in self.model.named_parameters():
@@ -221,6 +225,98 @@ class OracleWorker:
             self.optimizer.step()
             losses.append(float(loss.detach()))
         return float(np.mean(losses))
+
+    def inference(self, bx: np.ndarray, by: np.ndarray,
+                  bw: np.ndarray) -> tuple[float, float, float]:
+        """Reference ``Client.inference`` over a static [S, B, ...] NCHW
+        eval stack (P1 clients.py:61-75 / P2 clients.py:71-86): returns
+        (accuracy, summed-batch-loss [P1 flavour], mean-per-batch loss
+        [P2 flavour]); padding rows carry weight 0."""
+        self.model.eval()
+        losses, correct, total = [], 0.0, 0.0
+        with torch.no_grad():
+            for s in range(bx.shape[0]):
+                x = torch.from_numpy(np.ascontiguousarray(bx[s]))
+                y = torch.from_numpy(np.ascontiguousarray(by[s])).long()
+                w = torch.from_numpy(np.ascontiguousarray(bw[s]))
+                out = self.model(x)
+                per = F.cross_entropy(out, y, reduction="none")
+                losses.append(float((per * w).sum() / w.sum().clamp(min=1.0)))
+                pred = out.argmax(dim=1)
+                correct += float(((pred == y).float() * w).sum())
+                total += float(w.sum())
+        self.model.train()
+        acc = correct / max(total, 1.0)
+        return acc, float(np.sum(losses)), float(np.mean(losses))
+
+    def local_update_epochs(self, bx, by, bw, vx, vy, vw,
+                            theta: Mapping | None = None,
+                            c_global: Mapping | None = None,
+                            val_flavor: str = "mean") -> list[dict]:
+        """The reference's epoch-structured ``update_weights`` /
+        ``local_update`` (P1 clients.py:38-50, P2 clients.py:37-57):
+        bx is [E, S', B, ...] epoch-major; after each epoch's steps the
+        local validation stack (vx, vy, vw) is evaluated and a history
+        row {train_loss, train_acc, val_acc, val_loss} recorded
+        (val_loss in the P1 'sum' or P2 'mean' flavour)."""
+        rows = []
+        for e in range(bx.shape[0]):
+            correct_total = [0.0, 0.0]
+            losses: list[float] = []
+            # reuse the flat-step path for one epoch's steps, tracking
+            # train metrics per step
+            loss_mean = self._epoch_steps(bx[e], by[e], bw[e], theta,
+                                          c_global, losses, correct_total)
+            vacc, vsum, vmean = self.inference(vx, vy, vw)
+            rows.append({
+                "epoch": e,
+                "train_loss": loss_mean,
+                "train_acc": correct_total[0] / max(correct_total[1], 1.0),
+                "val_acc": vacc,
+                "val_loss": vsum if val_flavor == "sum" else vmean,
+            })
+        return rows
+
+    def _epoch_steps(self, bx, by, bw, theta, c_global, losses,
+                     correct_total) -> float:
+        """One epoch of SGD steps ([S, B, ...]), accumulating per-batch
+        losses and the weighted correct count; returns the epoch's mean
+        batch loss (``sum(train_loss)/len(train_loss)``)."""
+        theta_t = ({k: v.detach().clone() for k, v in theta.items()}
+                   if theta is not None else None)
+        for s in range(bx.shape[0]):
+            x = torch.from_numpy(np.ascontiguousarray(bx[s]))
+            y = torch.from_numpy(np.ascontiguousarray(by[s])).long()
+            w = torch.from_numpy(np.ascontiguousarray(bw[s]))
+            self.optimizer.zero_grad()
+            out = self.model(x)
+            per = F.cross_entropy(out, y, reduction="none")
+            loss = (per * w).sum() / w.sum().clamp(min=1.0)
+            if self.l2:
+                loss = loss + 0.5 * self.l2 * sum(
+                    (p ** 2).sum() for p in self.model.parameters())
+            loss.backward()
+            if self.algorithm in ("fedprox", "fedadmm"):
+                for n, p in self.model.named_parameters():
+                    if p.grad is None:
+                        continue
+                    extra = self.rho * (p.detach() - theta_t[n])
+                    if self.algorithm == "fedadmm":
+                        extra = extra + self.alpha[n]
+                    p.grad = p.grad + extra
+            elif self.algorithm == "scaffold":
+                for n, p in self.model.named_parameters():
+                    if p.grad is None:
+                        continue
+                    p.grad = p.grad - self.control[n] + c_global[n]
+            self.optimizer.step()
+            losses.append(float(loss.detach()))
+            with torch.no_grad():
+                pred = out.argmax(dim=1)
+                correct_total[0] += float(((pred == y).float() * w).sum())
+                correct_total[1] += float(w.sum())
+        ep_losses = losses[-bx.shape[0]:]
+        return float(np.mean(ep_losses))
 
     def update_duals(self, theta: Mapping) -> None:
         """ADMM dual ascent after the local epochs (clients.py:141-144)."""

@@ -57,34 +57,49 @@ def top_k_compress(tree, ratio: float):
 
 
 def rand_k_compress(tree, ratio: float, key):
-    """Random-k sparsification with 1/ratio rescaling (unbiased).  The
-    mask is drawn from ``key`` per leaf — pass a per-round key so
-    workers/rounds decorrelate."""
+    """Fixed-cardinality random-k sparsification with n/k rescaling
+    (unbiased): EXACTLY k = ceil(ratio · leaf_size) entries per worker
+    per leaf survive, drawn uniformly without replacement (top-k over a
+    random-score tensor — a static-shape permutation draw), matching
+    the rand-k operator of the compression literature so a packed
+    transport has a FIXED wire size per round.  The index set is drawn
+    from ``key`` per leaf — pass a per-round key so workers/rounds
+    decorrelate."""
     if ratio >= 1.0:
         return tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     keys = jax.random.split(key, len(leaves))
 
-    def comp(x, k):
-        mask = (jax.random.uniform(k, x.shape) < ratio).astype(x.dtype)
-        return x * mask / jnp.asarray(ratio, x.dtype)
+    def comp(x, k_):
+        w = x.shape[0]
+        n = math.prod(x.shape[1:]) or 1
+        k = max(int(math.ceil(ratio * n)), 1)
+        flat = x.reshape(w, n)
+        scores = jax.random.uniform(k_, (w, n))
+        _, idx = jax.lax.top_k(scores, k)                 # k uniform w/o repl.
+        mask = jnp.zeros((w, n), x.dtype).at[
+            jnp.arange(w)[:, None], idx].set(1)
+        scale = jnp.asarray(n / k, x.dtype)               # E[x̂] = x
+        return (flat * mask * scale).reshape(x.shape)
 
     return jax.tree_util.tree_unflatten(
         treedef, [comp(x, k) for x, k in zip(leaves, keys)])
 
 
-def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048):
+def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048,
+                  levels: int | None = None):
     """QSGD stochastic quantization (Alistarh et al. 2017), per worker
     per leaf: x → ‖x‖₂ · sign(x) · ξ(x)/s with ξ an unbiased stochastic
-    rounding of s·|x|/‖x‖₂ to integer levels.  ``ratio`` sets the level
-    count s = max(round(ratio · 256), 1) — the fraction of an 8-bit
-    range used; smaller ratio = coarser quantization = fewer wire bits
-    in a real packed transport.
+    rounding of s·|x|/‖x‖₂ to integer levels.  The level count s comes
+    from ``levels`` directly when given (``GossipConfig.qsgd_levels``),
+    else from ``ratio`` as s = max(round(ratio · 256), 1) — the fraction
+    of an 8-bit range used; smaller s = coarser quantization = fewer
+    wire bits in a real packed transport.
 
     Norms are per ``bucket_size`` chunk (standard QSGD bucketing):
     without it the quantization step scales with the WHOLE leaf's norm
     (~√N · rms) and the noise swamps million-parameter models."""
-    s = max(int(round(ratio * 256)), 1)
+    s = levels if levels else max(int(round(ratio * 256)), 1)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     keys = jax.random.split(key, len(leaves))
 
@@ -113,12 +128,13 @@ def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048):
         treedef, [comp(x, k) for x, k in zip(leaves, keys)])
 
 
-def make_compressor(name: str, ratio: float):
+def make_compressor(name: str, ratio: float, *, qsgd_levels: int = 0):
     """Operator factory: (tree, key) → compressed tree.
 
     'topk'  — deterministic magnitude top-k (ignores the key)
-    'randk' — unbiased random-k with rescaling
-    'qsgd'  — unbiased stochastic quantization (ratio sets level count)
+    'randk' — unbiased fixed-cardinality random-k with rescaling
+    'qsgd'  — unbiased stochastic quantization; level count from
+              ``qsgd_levels`` when > 0, else from ratio (ratio·256)
     'none'  — identity (ratio ignored)
     """
     if name not in ("none", "topk", "randk", "qsgd"):
@@ -128,10 +144,16 @@ def make_compressor(name: str, ratio: float):
         # ratio=0 would divide by zero in randk (NaN params on round 0)
         # and negative ratios would silently zero all communication.
         raise ValueError(f"compression_ratio must be in (0, 1], got {ratio}")
+    if qsgd_levels and name != "qsgd":
+        raise ValueError(
+            f"qsgd_levels only applies to compression='qsgd' (got {name!r})")
+    if qsgd_levels < 0:
+        raise ValueError(f"qsgd_levels must be >= 0, got {qsgd_levels}")
     if name == "none" or (name != "qsgd" and ratio >= 1.0):
         return lambda tree, key: tree
     if name == "topk":
         return lambda tree, key: top_k_compress(tree, ratio)
     if name == "qsgd":
-        return lambda tree, key: qsgd_compress(tree, ratio, key)
+        return lambda tree, key: qsgd_compress(tree, ratio, key,
+                                               levels=qsgd_levels or None)
     return lambda tree, key: rand_k_compress(tree, ratio, key)

@@ -96,20 +96,20 @@ def _mix_dense_compressed(stacked, w, mesh: Mesh, comm_dtype):
     return jax.tree.map(mix_leaf, stacked)
 
 
-def mix_shifts_shardmap(stacked, shifts, mesh: Mesh, comm_dtype=None):
+def mix_shifts(stacked, shift_ids, coeff_table, mesh: Mesh, comm_dtype=None):
     """Explicit ICI path: x_i ← Σ_s coeff_s[i] · x_{(i+s) mod n}.
 
-    ``shifts`` is ``[(shift, coeffs[n]), ...]`` from
-    ``dopt.topology.shift_decomposition``.  Requires one worker per
-    device (workers == mesh.size); the engine falls back to
-    ``mix_dense`` otherwise.  Each shift is one ``lax.ppermute`` ring
-    rotation — the canonical ICI-friendly pattern.
+    ``shift_ids`` is the STATIC tuple of circulant shifts (compiled into
+    the program — one ``lax.ppermute`` ring rotation each, the canonical
+    ICI-friendly pattern); ``coeff_table`` is the per-round [k, n]
+    float32 coefficient DATA (``dopt.topology.coeffs_for_matrix``), so
+    time-varying schedules and dropout-repaired matrices reuse one
+    compiled step.  Requires one worker per device (workers ==
+    mesh.size); the engine falls back to ``mix_dense`` otherwise.
     """
     n = mesh.size
-    shift_ids = [int(s) for s, _ in shifts]
-    coeff_table = jnp.asarray(  # [k, n] float32
-        [c for _, c in shifts], dtype=jnp.float32
-    )
+    shift_ids = tuple(int(s) for s in shift_ids)
+    coeff_table = jnp.asarray(coeff_table, dtype=jnp.float32)
 
     def per_device(coeffs, x):
         # x: [1, ...] local worker shard; coeffs: [k, 1] this worker's weights
@@ -141,6 +141,15 @@ def mix_shifts_shardmap(stacked, shifts, mesh: Mesh, comm_dtype=None):
         return fn(coeff_table, x)
 
     return jax.tree.map(mix_leaf, stacked)
+
+
+def mix_shifts_shardmap(stacked, shifts, mesh: Mesh, comm_dtype=None):
+    """``mix_shifts`` with the shifts-and-coefficients pairing of
+    ``dopt.topology.shift_decomposition`` (``[(shift, coeffs[n]), ...]``)
+    — the single-matrix convenience form."""
+    return mix_shifts(stacked, [s for s, _ in shifts],
+                      jnp.asarray([c for _, c in shifts], dtype=jnp.float32),
+                      mesh, comm_dtype)
 
 
 def where_mask(mask, a, b):

@@ -24,10 +24,11 @@ MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
 CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
 
 
-def _mnist_data(num_users: int, iid: bool, shards: int = 2) -> DataConfig:
+def _mnist_data(num_users: int, iid: bool, shards: int = 2,
+                **kw) -> DataConfig:
     return DataConfig(dataset="mnist", num_users=num_users, iid=iid,
                       shards=shards, synthetic_train_size=MNIST_TRAIN,
-                      synthetic_test_size=MNIST_TEST)
+                      synthetic_test_size=MNIST_TEST, **kw)
 
 
 def _cifar_data(num_users: int, iid: bool, shards: int = 2) -> DataConfig:
@@ -41,10 +42,15 @@ def _cifar_data(num_users: int, iid: bool, shards: int = 2) -> DataConfig:
 # ---------------------------------------------------------------------
 
 def reference_federated(algorithm: str = "fedavg") -> ExperimentConfig:
-    """P1 notebook setup (cells 8/10): FedAvg/FedProx/FedADMM, 100 users."""
+    """P1 notebook setup (cells 8/10): FedAvg/FedProx/FedADMM, 100 users.
+
+    Includes the reference's 90/10 local train/val holdout (each client
+    trains on 90% of its shard, P1 clients.py:25-28 — deterministic
+    first-10% val split) with per-epoch client history rows."""
     return ExperimentConfig(
         name=f"reference-{algorithm}", seed=2022,
-        data=_mnist_data(100, iid=True),
+        data=_mnist_data(100, iid=True, local_holdout=0.1,
+                         holdout_mode="deterministic"),
         model=ModelConfig(model="model1", faithful=True),
         optim=OptimizerConfig(lr=0.1, momentum=0.5, rho=0.1),
         federated=FederatedConfig(algorithm=algorithm, frac=0.1, rounds=20,
@@ -55,10 +61,15 @@ def reference_federated(algorithm: str = "fedavg") -> ExperimentConfig:
 def reference_gossip(algorithm: str = "dsgd", topology: str = "circle",
                      mode: str = "stochastic", iid: bool = False,
                      eps: int = 1) -> ExperimentConfig:
-    """P2 notebook setup (cell 11): 6 workers, the topology/mode grid."""
+    """P2 notebook setup (cell 11): 6 workers, the topology/mode grid.
+
+    Includes the reference's 90/10 local train/val holdout (P2
+    clients.py:20-22 — seeded random val choice) with per-epoch client
+    history rows."""
     return ExperimentConfig(
         name=f"reference-{algorithm}-{topology}-{mode}", seed=2028,
-        data=_mnist_data(6, iid=iid),
+        data=_mnist_data(6, iid=iid, local_holdout=0.1,
+                         holdout_mode="random"),
         model=ModelConfig(model="model1", faithful=True),
         optim=OptimizerConfig(lr=0.01, momentum=0.5),
         gossip=GossipConfig(algorithm=algorithm, topology=topology, mode=mode,
@@ -110,7 +121,9 @@ def baseline_3_fedavg_noniid() -> ExperimentConfig:
 
 
 def baseline_4_admm_a9a() -> ExperimentConfig:
-    """ADMM dual decomposition, 16 workers, l2 logistic regression, a9a."""
+    """ADMM dual decomposition, 16 workers, ℓ2-regularised logistic
+    regression on a9a (λ = 1e-4 via OptimizerConfig.weight_decay — the
+    ℓ2 term is a real loss term, see dopt.models.losses.l2_regulariser)."""
     return ExperimentConfig(
         name="baseline4-admm16-a9a", seed=0,
         data=DataConfig(dataset="a9a", num_users=16, iid=True,
@@ -118,7 +131,8 @@ def baseline_4_admm_a9a() -> ExperimentConfig:
                         synthetic_test_size=16_281),
         model=ModelConfig(model="logistic", num_classes=2,
                           input_shape=(123,), faithful=False),
-        optim=OptimizerConfig(lr=0.05, momentum=0.0, rho=1.0),
+        optim=OptimizerConfig(lr=0.05, momentum=0.0, rho=1.0,
+                              weight_decay=1e-4),
         federated=FederatedConfig(algorithm="fedadmm", frac=1.0, rounds=50,
                                   local_ep=2, local_bs=128),
     )

@@ -109,7 +109,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="override any config field by dotted path, e.g. "
                          "--set gossip.topology=hierarchical "
                          "--set optim.lr=0.05 --set seed=7; value is coerced "
-                         "to the field's annotated type")
+                         "to the field's annotated type; for optional "
+                         "fields (e.g. gossip.comm_dtype) the literal "
+                         "strings 'none'/'null' set the field to None — "
+                         "they cannot be passed as string values there")
     args = ap.parse_args(argv)
 
     from dopt.presets import PRESETS, get_preset

@@ -360,6 +360,52 @@ def shift_decomposition(w: np.ndarray, max_shifts: int | None = None
     return shifts
 
 
+def schedule_shift_decomposition(
+    mixing: MixingMatrices,
+    *,
+    max_shifts: int | None = None,
+    extra_shifts: Sequence[int] = (),
+) -> tuple[int, ...] | None:
+    """Union of circulant shifts covering EVERY matrix in a (possibly
+    time-varying) mixing schedule.
+
+    The gossip engine compiles ONE round step for the whole run, so the
+    ppermute path needs a single static shift set that covers every
+    round's matrix; per-round coefficients then become data
+    (``coeffs_for_matrix``).  ``extra_shifts`` lets the engine force
+    shift 0 into the set when dropout repair may add identity rows.
+    Returns ``None`` when the union exceeds ``max_shifts`` (the dense
+    all_gather path is then the better mapping)."""
+    ids: set[int] = set(int(s) for s in extra_shifts)
+    for m in mixing.matrices:
+        dec = shift_decomposition(m)
+        assert dec is not None
+        ids.update(s for s, _ in dec)
+    out = tuple(sorted(ids))
+    if max_shifts is not None and len(out) > max_shifts:
+        return None
+    return out
+
+
+def coeffs_for_matrix(w: np.ndarray, shift_ids: Sequence[int]) -> np.ndarray:
+    """Extract the [k, n] circulant-diagonal coefficient table of ``w``
+    for a static shift set: ``coeffs[k, i] = w[i, (i + shift_ids[k]) % n]``.
+
+    Raises if ``w`` has support outside the shift set — the engine's
+    guarantee that the ppermute path computes exactly ``W @ x``."""
+    n = w.shape[0]
+    rows = np.arange(n)
+    coeffs = np.stack([w[rows, (rows + int(s)) % n] for s in shift_ids])
+    recon = np.zeros_like(w)
+    for k, s in enumerate(shift_ids):
+        recon[rows, (rows + int(s)) % n] = coeffs[k]
+    if not np.array_equal(recon, w):
+        raise ValueError(
+            f"matrix support is not covered by shifts {tuple(shift_ids)}"
+        )
+    return coeffs.astype(np.float32)
+
+
 def repair_for_dropout(w: np.ndarray, alive: np.ndarray) -> np.ndarray:
     """Rebuild a mixing matrix after worker failures (fault injection /
     elastic recovery — the subsystem SURVEY §5 notes the reference lacks

@@ -205,12 +205,20 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         "local_ep": g.local_ep,
         "local_bs": g.local_bs,
         "rounds_measured": rounds,
+        "block_rounds_used": rounds,   # all measured rounds fused in ONE
+        # lax.scan jit dispatch (the dispatch-overhead killer for small
+        # models — baseline4's 248-param logistic round is pure host
+        # overhead without it)
         "tpu_rounds_per_sec": round(rps, 4),
         "tpu_samples_per_sec": round(rps * samples_per_round, 1),
         "compute_dtype": "bfloat16",
     }
     if not skip_oracle:
-        max_steps = 8 if (quick or cfg.model.model == "resnet18") else None
+        # resnet18: a full 800-step round on 1 CPU core takes ~minutes;
+        # 24 timed steady-state steps bound the per-step time well (the
+        # extrapolation provenance is recorded in oracle_steps_timed).
+        max_steps = 8 if quick else (24 if cfg.model.model == "resnet18"
+                                     else None)
         oracle_s, steps_timed, steps_total = oracle_round_seconds(
             cfg, trainer.index_matrix, trainer.dataset,
             local_ep=g.local_ep, local_bs=g.local_bs,

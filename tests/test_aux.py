@@ -153,3 +153,20 @@ def test_time_to_target():
     assert hit == {"reached": True, "round": 3, "rounds": 4, "seconds": 8.0}
     miss = time_to_target(h, target=0.99)
     assert miss["reached"] is False and miss["seconds"] is None
+
+
+def test_client_grid_plot(tmp_path, devices):
+    pytest.importorskip("matplotlib")
+    from dopt.utils.plotting import client_grid_plot
+    from tests.test_engine import _holdout_gossip_cfg
+    from dopt.engine import GossipTrainer
+
+    tr = GossipTrainer(_holdout_gossip_cfg())
+    tr.run(rounds=2)
+    out = client_grid_plot(tr.client_history, num_workers=tr.num_workers,
+                           title="per-client", save=tmp_path / "grid.png")
+    assert out.exists() and out.stat().st_size > 0
+    # empty history: loud error pointing at the holdout knob
+    from dopt.utils.metrics import History
+    with pytest.raises(ValueError, match="local_holdout"):
+        client_grid_plot(History("empty"))

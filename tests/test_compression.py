@@ -167,3 +167,76 @@ def test_choco_qsgd_learns(devices):
     tr = GossipTrainer(cfg)
     h = tr.run()
     assert h.last()["avg_test_acc"] > 0.5
+
+
+def test_randk_fixed_cardinality():
+    """rand-k keeps EXACTLY ceil(ratio·n) entries per worker per leaf
+    (fixed wire size), uniformly without replacement, unbiased."""
+    import jax
+
+    x = {"a": jnp.ones((4, 100)), "b": jnp.ones((4, 7))}
+    out = rand_k_compress(x, 0.25, jax.random.key(0))
+    for name, n, k in (("a", 100, 25), ("b", 7, 2)):
+        nz = np.count_nonzero(np.asarray(out[name]), axis=1)
+        np.testing.assert_array_equal(nz, k)
+        # surviving entries carry the n/k unbiasedness rescale
+        vals = np.asarray(out[name])
+        assert np.allclose(vals[vals != 0], n / k, rtol=1e-6)
+    # unbiased in expectation over keys
+    means = np.mean([np.asarray(
+        rand_k_compress(x, 0.25, jax.random.key(s))["a"]).mean()
+        for s in range(64)])
+    assert abs(means - 1.0) < 0.05
+
+
+def test_qsgd_levels_knob():
+    import jax
+
+    from dopt.ops.compression import make_compressor
+
+    x = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(2, 512)),
+                          jnp.float32)}
+    # explicit coarse level count quantizes more harshly than 256 levels
+    c4 = make_compressor("qsgd", 1.0, qsgd_levels=4)
+    c256 = make_compressor("qsgd", 1.0)
+    e4 = float(jnp.abs(c4(x, jax.random.key(1))["w"] - x["w"]).mean())
+    e256 = float(jnp.abs(c256(x, jax.random.key(1))["w"] - x["w"]).mean())
+    assert e4 > 3 * e256 > 0
+    with pytest.raises(ValueError, match="qsgd_levels"):
+        make_compressor("topk", 0.5, qsgd_levels=8)
+    with pytest.raises(ValueError, match="qsgd_levels"):
+        make_compressor("qsgd", 1.0, qsgd_levels=-1)
+
+
+def test_choco_gamma_warning(devices):
+    import dataclasses
+    import warnings
+
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+    from dopt.engine import GossipTrainer
+
+    def cfg(gamma, ratio):
+        return ExperimentConfig(
+            name="t", seed=0,
+            data=DataConfig(dataset="synthetic", num_users=8,
+                            synthetic_train_size=256,
+                            synthetic_test_size=64),
+            model=ModelConfig(model="mlp", faithful=False),
+            optim=OptimizerConfig(lr=0.05),
+            gossip=GossipConfig(algorithm="choco", topology="circle",
+                                mode="metropolis", rounds=1, local_ep=1,
+                                local_bs=32, choco_gamma=gamma,
+                                compression="topk",
+                                compression_ratio=ratio),
+        )
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        GossipTrainer(cfg(1.0, 0.1))
+    assert any("choco_gamma" in str(w.message) for w in rec)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        GossipTrainer(cfg(1.0, 1.0))   # identity compressor: fine
+        GossipTrainer(cfg(0.05, 0.1))  # scaled-down gamma: fine
+    assert not any("choco_gamma" in str(w.message) for w in rec)

@@ -148,3 +148,58 @@ def test_batch_plan_worker_subset_matches_full_plan_rows():
     assert sub.idx.shape == (3, 8, 32)
     np.testing.assert_array_equal(sub.idx, full.idx[sel])
     np.testing.assert_array_equal(sub.weight, full.weight[sel])
+
+
+def test_holdout_split_deterministic_first_tenth():
+    """P1 train_val_test: val = FIRST max(int(L/10),1) indices of the
+    shard, train = the rest (clients.py:25-28)."""
+    from dopt.data import holdout_split
+
+    im = np.arange(200).reshape(4, 50)
+    train, val = holdout_split(im, fraction=0.1, mode="deterministic")
+    assert val.shape == (4, 5) and train.shape == (4, 45)
+    np.testing.assert_array_equal(val, im[:, :5])
+    np.testing.assert_array_equal(train, im[:, 5:])
+
+
+def test_holdout_split_random_properties():
+    """P2: seeded random val choice — disjoint, exhaustive, val_size
+    rows, deterministic in seed, different across workers."""
+    from dopt.data import holdout_split
+
+    im = np.sort(np.random.default_rng(0).choice(10_000, (6, 120),
+                                                 replace=False), axis=1)
+    train, val = holdout_split(im, fraction=0.1, mode="random", seed=9)
+    assert val.shape == (6, 12) and train.shape == (6, 108)
+    for i in range(6):
+        t, v = set(train[i]), set(val[i])
+        assert not t & v
+        assert t | v == set(im[i])
+    train2, val2 = holdout_split(im, fraction=0.1, mode="random", seed=9)
+    np.testing.assert_array_equal(val, val2)
+    # different workers draw different val positions
+    assert not all(
+        set(np.searchsorted(im[i], val[i])) ==
+        set(np.searchsorted(im[0], val[0])) for i in range(1, 6))
+
+
+def test_holdout_split_validation():
+    from dopt.data import holdout_split
+
+    im = np.arange(40).reshape(4, 10)
+    with pytest.raises(ValueError, match="fraction"):
+        holdout_split(im, fraction=0.0)
+    with pytest.raises(ValueError, match="holdout_mode"):
+        holdout_split(im, mode="nope")
+    with pytest.raises(ValueError, match="no training data"):
+        holdout_split(np.arange(4).reshape(4, 1), fraction=0.5)
+
+
+def test_stacked_eval_batches_padding():
+    from dopt.data import stacked_eval_batches
+
+    im = np.arange(42).reshape(2, 21)
+    idx, w = stacked_eval_batches(im, batch_size=8)
+    assert idx.shape == (2, 3, 8) and w.shape == (2, 3, 8)
+    assert w.sum() == 42  # every real sample weighted once
+    np.testing.assert_array_equal(idx[0].ravel()[:21], im[0])

@@ -22,7 +22,8 @@ def _gossip_cfg(**kw):
         seed=7,
         data=DataConfig(dataset="synthetic", num_users=kw.pop("num_users", 8),
                         iid=kw.pop("iid", True), shards=2,
-                        synthetic_train_size=512, synthetic_test_size=128),
+                        synthetic_train_size=512, synthetic_test_size=128,
+                        **kw.pop("data_extra", {})),
         model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
                           faithful=False),
         optim=OptimizerConfig(lr=0.1, momentum=0.5),
@@ -404,3 +405,274 @@ def test_federated_comm_compression_trains(devices):
     h = tr.run(rounds=3)
     ref = FederatedTrainer(_fed_cfg("fedavg")).run(rounds=3)
     assert abs(h.last()["test_acc"] - ref.last()["test_acc"]) < 0.1
+
+
+# ---------------------------------------------------------------------
+# comm_impl: the ppermute shift path vs the dense all_gather path
+# ---------------------------------------------------------------------
+
+def _leaves(tr):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(tr.params))]
+
+
+def _shift_cfg(comm_impl, **kw):
+    g = dict(mode="uniform", rounds=6, comm_impl=comm_impl)
+    g.update(kw.pop("gossip", {}))
+    return _gossip_cfg(gossip=g, **kw)
+
+
+def test_comm_impl_shift_bitwise_equals_dense_uniform_ring(devices):
+    """Full GossipTrainer.run, 8 workers on the 8-device mesh: the
+    ppermute path must be BIT-identical to the dense path.  Uniform ring
+    weights (1/2, 1/2) make every per-row product exact, so the two
+    paths' different accumulation (gemm FMA vs mul+add) cannot round
+    differently — any bit difference is a real routing bug."""
+    td = GossipTrainer(_shift_cfg("dense"))
+    ts = GossipTrainer(_shift_cfg("shift"))
+    assert ts._shift_ids == (1, 7)
+    hd, hs = td.run(), ts.run()
+    assert hd.rows == hs.rows
+    for a, b in zip(_leaves(td), _leaves(ts)):
+        assert np.array_equal(a, b)
+
+
+def test_comm_impl_shift_bitwise_equals_dense_dynamic_dropout(devices):
+    """Time-varying single-edge graphs + dropout repair: per-round
+    matrices (repaired as data) must stay inside the compiled shift set
+    {0, 1, n-1} and match the dense path bit-for-bit (each row has at
+    most one neighbor term, so no accumulation-order freedom exists)."""
+    g = dict(topology="dynamic", mode="stochastic", dropout=0.3)
+    td = GossipTrainer(_shift_cfg("dense", gossip=g))
+    ts = GossipTrainer(_shift_cfg("shift", gossip=g))
+    assert ts._shift_ids == (0, 1, 7)
+    hd, hs = td.run(), ts.run()
+    assert hd.rows == hs.rows
+    for a, b in zip(_leaves(td), _leaves(ts)):
+        assert np.array_equal(a, b)
+
+
+def test_comm_impl_shift_close_for_stochastic_ring(devices):
+    """Random (non-dyadic) ring weights: dense gemm uses FMA so the last
+    bit can differ; the paths must agree to float32 rounding noise and
+    produce identical history metrics."""
+    g = dict(mode="stochastic")
+    td = GossipTrainer(_shift_cfg("dense", gossip=g))
+    ts = GossipTrainer(_shift_cfg("shift", gossip=g))
+    hd, hs = td.run(), ts.run()
+    for rd, rs in zip(hd.rows, hs.rows):
+        assert rd.keys() == rs.keys()
+        for k in rd:
+            assert rd[k] == pytest.approx(rs[k], abs=1e-5)
+    for a, b in zip(_leaves(td), _leaves(ts)):
+        np.testing.assert_allclose(a, b, atol=2e-6, rtol=1e-5)
+
+
+def test_comm_impl_shift_blocked_matches_per_round(devices):
+    """The fused lax.scan block path must dispatch the same compiled
+    shift mix: blocked vs per-round bit-equality, through run()."""
+    ts = GossipTrainer(_shift_cfg("shift"))
+    ts.run()
+    tb = GossipTrainer(_shift_cfg("shift"))
+    tb.run(block=3)
+    assert ts.history.rows == tb.history.rows
+    for a, b in zip(_leaves(ts), _leaves(tb)):
+        assert np.array_equal(a, b)
+
+
+def test_comm_impl_shift_choco_and_fedlcon(devices):
+    """choco mixes its public copies x̂ through the same mix_once; fedlcon
+    applies eps sweeps inside one jit — both must match dense exactly on
+    uniform weights."""
+    for g in (dict(algorithm="choco", rounds=4),
+              dict(algorithm="fedlcon", eps=3, rounds=4)):
+        td = GossipTrainer(_shift_cfg("dense", gossip=g))
+        ts = GossipTrainer(_shift_cfg("shift", gossip=g))
+        td.run(), ts.run()
+        assert ts._shift_ids is not None
+        for a, b in zip(_leaves(td), _leaves(ts)):
+            assert np.array_equal(a, b)
+
+
+def test_comm_impl_auto_and_validation(devices):
+    # auto picks shift exactly when workers == devices and the schedule
+    # decomposes into few diagonals.
+    assert GossipTrainer(_shift_cfg("auto"))._shift_ids == (1, 7)
+    # complete graph on 8 workers: 7 diagonals > n/2 -> dense.
+    assert GossipTrainer(_shift_cfg(
+        "auto", gossip=dict(topology="complete")))._shift_ids is None
+    # workers fold 2-per-device: no one-worker-per-device mapping.
+    assert GossipTrainer(_shift_cfg(
+        "auto", num_users=16))._shift_ids is None
+    # explicit shift honors an expensive decomposition (complete = all 7).
+    tr = GossipTrainer(_shift_cfg("shift", gossip=dict(topology="complete")))
+    assert tr._shift_ids == tuple(range(1, 8))
+    # explicit shift where no mapping exists must fail loudly.
+    with pytest.raises(ValueError, match="comm_impl='shift'"):
+        GossipTrainer(_shift_cfg("shift", num_users=16))
+    with pytest.raises(ValueError, match="mixing-schedule algorithm"):
+        GossipTrainer(_shift_cfg("shift", gossip=dict(algorithm="gossip")))
+    with pytest.raises(ValueError, match="comm_impl"):
+        GossipTrainer(_shift_cfg("nonsense"))
+
+
+# ---------------------------------------------------------------------
+# Local train/val holdout (reference train_val_test semantics)
+# ---------------------------------------------------------------------
+
+def _holdout_gossip_cfg(block=1, holdout=0.1):
+    return _gossip_cfg(
+        gossip=dict(mode="uniform", rounds=3, local_ep=2,
+                    block_rounds=block),
+        data_extra=dict(local_holdout=holdout, holdout_mode="random"),
+    )
+
+
+def test_gossip_holdout_trains_on_subshard_with_client_history(devices):
+    tr = GossipTrainer(_holdout_gossip_cfg())
+    tr.run()
+    w, l = tr.index_matrix.shape
+    val_size = max(int(l * 0.1), 1)
+    assert tr._train_matrix.shape == (w, l - val_size)
+    # every batch-plan index must come from the train sub-shard
+    from dopt.data import make_batch_plan
+    plan = make_batch_plan(tr._train_matrix, batch_size=32, local_ep=2,
+                           seed=tr.cfg.seed, round_idx=0)
+    for i in range(w):
+        assert set(plan.idx[i].ravel()) <= set(tr._train_matrix[i])
+    # per-epoch per-worker rows, P2 schema
+    rows = tr.client_history.rows
+    assert len(rows) == 3 * w * 2
+    assert set(rows[0]) == {"round", "iter", "worker", "train_loss",
+                            "train_acc", "val_acc", "val_loss"}
+    # blocked run: identical history and client rows
+    tb = GossipTrainer(_holdout_gossip_cfg(block=3))
+    tb.run()
+    assert tb.history.rows == tr.history.rows
+    assert tb.client_history.rows == rows
+
+
+def test_federated_holdout_client_history_sampled_only(devices):
+    import dataclasses as _dc
+
+    def fed(compact=None, mesh_devices=None):
+        cfg = _fed_cfg("fedavg")
+        cfg = cfg.replace(
+            data=_dc.replace(cfg.data, local_holdout=0.1,
+                             holdout_mode="deterministic"),
+            federated=_dc.replace(cfg.federated, compact=compact),
+            mesh_devices=mesh_devices,
+        )
+        return cfg
+
+    tr = FederatedTrainer(fed())
+    tr.run(rounds=3)
+    rows = tr.client_history.rows
+    m = max(int(0.5 * 8), 1)
+    assert len(rows) == 3 * m * 1  # local_ep=1
+    assert set(rows[0]) == {"global_round", "epoch", "worker", "train_loss",
+                            "train_acc", "val_acc", "val_loss"}
+    # only sampled workers appear per round
+    for t in range(3):
+        assert len([r for r in rows if r["global_round"] == t]) == m
+    # compact path (1-device) produces the same rows
+    tc = FederatedTrainer(fed(compact=True, mesh_devices=1))
+    tc.run(rounds=3)
+    assert [r["worker"] for r in tc.client_history.rows] == [
+        r["worker"] for r in rows]
+    for a, b in zip(tc.client_history.rows, rows):
+        assert a["val_acc"] == pytest.approx(b["val_acc"], abs=1e-6)
+        assert a["train_loss"] == pytest.approx(b["train_loss"], abs=1e-5)
+
+
+def test_holdout_resume_preserves_client_history(devices, tmp_path):
+    tr = GossipTrainer(_holdout_gossip_cfg())
+    tr.run(rounds=2)
+    tr.save(tmp_path / "ck")
+    tr2 = GossipTrainer(_holdout_gossip_cfg())
+    tr2.restore(tmp_path / "ck")
+    assert tr2.client_history.rows == tr.client_history.rows
+    tr2.run(rounds=1)
+    tr.run(rounds=1)
+    assert tr2.client_history.rows == tr.client_history.rows
+
+
+# ---------------------------------------------------------------------
+# No dead config knobs: every field changes behavior or raises
+# ---------------------------------------------------------------------
+
+def test_weight_decay_changes_training(devices):
+    import jax
+
+    def fed(wd):
+        cfg = _fed_cfg("fedavg")
+        return cfg.replace(optim=dataclasses.replace(cfg.optim,
+                                                     weight_decay=wd))
+
+    a = FederatedTrainer(fed(0.0)); a.run(rounds=2)
+    b = FederatedTrainer(fed(0.1)); b.run(rounds=2)
+    la = jax.tree.leaves(jax.device_get(a.theta))
+    lb = jax.tree.leaves(jax.device_get(b.theta))
+    assert any(not np.allclose(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+    # the ℓ2 term shrinks the solution norm
+    na = sum(float((np.asarray(x) ** 2).sum()) for x in la)
+    nb = sum(float((np.asarray(x) ** 2).sum()) for x in lb)
+    assert nb < na
+
+    def gos(wd):
+        cfg = _gossip_cfg()
+        return cfg.replace(optim=dataclasses.replace(cfg.optim,
+                                                     weight_decay=wd))
+
+    ga = GossipTrainer(gos(0.0)); ga.run(rounds=2)
+    gb = GossipTrainer(gos(0.1)); gb.run(rounds=2)
+    assert any(
+        not np.allclose(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(jax.device_get(ga.params)),
+                        jax.tree.leaves(jax.device_get(gb.params))))
+
+
+def test_unknown_optimizer_rejected(devices):
+    cfg = _fed_cfg("fedavg")
+    cfg = cfg.replace(optim=dataclasses.replace(cfg.optim, optimizer="adam"))
+    with pytest.raises(ValueError, match="optimizer"):
+        FederatedTrainer(cfg)
+    cfg = _gossip_cfg()
+    cfg = cfg.replace(optim=dataclasses.replace(cfg.optim, optimizer="adam"))
+    with pytest.raises(ValueError, match="optimizer"):
+        GossipTrainer(cfg)
+
+
+def test_param_dtype_controls_state_storage(devices):
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _gossip_cfg()
+    cfg = cfg.replace(model=dataclasses.replace(cfg.model,
+                                                param_dtype="bfloat16"))
+    tr = GossipTrainer(cfg)
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(tr.params))
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(tr.momentum))
+    h = tr.run(rounds=2)
+    assert len(h) == 2
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(tr.params))
+
+    fcfg = _fed_cfg("fedadmm")
+    fcfg = fcfg.replace(model=dataclasses.replace(fcfg.model,
+                                                  param_dtype="bfloat16"))
+    ft = FederatedTrainer(fcfg)
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(ft.theta))
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(ft.duals))
+    ft.run(rounds=1)
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(ft.theta))
+
+
+def test_from_reference_args_rejects_unequal(devices):
+    from dopt.config import from_reference_args
+
+    with pytest.raises(ValueError, match="unequal"):
+        from_reference_args({"dataset": "mnist", "unequal": True})
+    cfg = from_reference_args({"dataset": "mnist"})
+    assert not hasattr(cfg.data, "unequal")
