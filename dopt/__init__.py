@@ -28,6 +28,7 @@ from dopt.config import (
     GossipConfig,
     ModelConfig,
     OptimizerConfig,
+    SeqLMConfig,
     from_reference_args,
 )
 from dopt.topology import MixingMatrices, Topology, build_mixing_matrices
@@ -39,6 +40,7 @@ __version__ = "0.1.0"
 _LAZY = {
     "GossipTrainer": ("dopt.engine", "GossipTrainer"),
     "FederatedTrainer": ("dopt.engine", "FederatedTrainer"),
+    "SeqLMTrainer": ("dopt.engine", "SeqLMTrainer"),
     "build_model": ("dopt.models", "build_model"),
     "get_preset": ("dopt.presets", "get_preset"),
 }
@@ -65,6 +67,7 @@ __all__ = [
     "GossipConfig",
     "ModelConfig",
     "OptimizerConfig",
+    "SeqLMConfig",
     "MixingMatrices",
     "Topology",
     "build_mixing_matrices",

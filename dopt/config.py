@@ -190,6 +190,33 @@ class GossipConfig:
 
 
 @dataclass(frozen=True)
+class SeqLMConfig:
+    """Sequence-parallel language-model training (``dopt.engine.seqlm``).
+
+    Nothing like it exists in the reference (no attention, no sequence
+    axis — SURVEY §2.3); this drives the framework's long-context
+    substrate (``dopt.parallel.sequence``) as a real training component:
+    a decoder-only TransformerLM with the SEQUENCE axis sharded over the
+    mesh and attention running as ring (ppermute KV rotation) or
+    Ulysses (all_to_all head resharding) — exact, not approximate."""
+
+    steps: int = 60
+    batch: int = 8
+    seq_len: int = 512       # divisible by the mesh size
+    vocab: int = 64
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    attn: str = "ring"       # ring | ulysses | dense (single-device)
+    kv_chunk: int = 0
+    # ring only: scan each ring block's KV in chunks of this size
+    # (flash-style) so per-device score memory is O(block·kv_chunk)
+    # instead of O(block²) — the long-sequence memory knob.  0 = whole
+    # block at once; must divide seq_len / mesh_size.
+    log_every: int = 10
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     """Top-level experiment description = the notebook form cell, typed."""
 
@@ -200,6 +227,7 @@ class ExperimentConfig:
     optim: OptimizerConfig = field(default_factory=OptimizerConfig)
     federated: FederatedConfig | None = None
     gossip: GossipConfig | None = None
+    seqlm: SeqLMConfig | None = None
     # Execution backend: "jax" (TPU/mesh path) or "torch" (faithful CPU oracle).
     backend: str = "jax"
     # Mesh shape: workers are folded onto devices; workers_per_device>1

@@ -1,4 +1,5 @@
 from dopt.engine.federated import FederatedTrainer
 from dopt.engine.gossip import GossipTrainer
+from dopt.engine.seqlm import SeqLMTrainer
 
-__all__ = ["FederatedTrainer", "GossipTrainer"]
+__all__ = ["FederatedTrainer", "GossipTrainer", "SeqLMTrainer"]

@@ -132,7 +132,15 @@ class FederatedTrainer:
         pdt = jnp.dtype(cfg.model.param_dtype)
         theta0 = jax.tree.map(lambda x: x.astype(pdt), theta0)
         self.param_count = count_params(theta0)
-        self.theta = jax.device_get(theta0)  # global model (replicated)
+        # Global model: device-resident + replicated FROM CONSTRUCTION,
+        # so the first jitted round sees the same input types as every
+        # later one (a numpy theta would make call 2 retrace — the
+        # trace cache keys on array type/sharding, and the round's
+        # output theta is a committed device array).
+        from dopt.parallel.mesh import replicated_sharding
+
+        self._replicated = replicated_sharding(self.mesh)
+        self.theta = jax.device_put(theta0, self._replicated)
         stacked = jax.device_get(broadcast_to_workers(theta0, w))
         self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
@@ -146,7 +154,8 @@ class FederatedTrainer:
             if f.algorithm in ("fedadmm", "scaffold") else None
         )
         self.c_global = (
-            jax.tree.map(np.zeros_like, self.theta)
+            jax.device_put(jax.tree.map(jnp.zeros_like, self.theta),
+                           self._replicated)
             if f.algorithm == "scaffold" else None
         )
 
@@ -631,7 +640,7 @@ class FederatedTrainer:
                 f"{self.cfg.federated.algorithm} trainer requires its "
                 "worker-stacked companion state ('duals') in the checkpoint"
             )
-        self.theta = arrays["theta"]
+        self.theta = jax.device_put(arrays["theta"], self._replicated)
         self.params = shard_worker_tree(arrays["params"], self.mesh)
         if "momentum" in arrays:
             self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
@@ -642,7 +651,8 @@ class FederatedTrainer:
                 raise ValueError(
                     "scaffold trainer requires the server control variate "
                     "('c_global') in the checkpoint")
-            self.c_global = arrays["c_global"]
+            self.c_global = jax.device_put(arrays["c_global"],
+                                           self._replicated)
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
         self.client_history.rows = list(meta.get("client_history", []))

@@ -58,8 +58,9 @@ def _reject_sequence_model(cfg: ExperimentConfig) -> None:
         raise ValueError(
             "model='transformer' is a sequence model and is not drivable by "
             "the federated/gossip engines (their datasets are image/feature "
-            "tensors); build it via dopt.models.build_model and train with "
-            "dopt.parallel.sequence (ring/Ulysses attention) directly"
+            "tensors); use the sequence-parallel LM engine instead: "
+            "SeqLMConfig + dopt.engine.SeqLMTrainer "
+            "(python -m dopt.run --preset seqlm)"
         )
 
 
@@ -238,8 +239,10 @@ class GossipTrainer:
             extra = (0,) if has_dropout else ()
             # auto: only take the shift path when it beats all_gather
             # comfortably; explicit 'shift' honors any decomposable set.
+            # Floor of 3 so self-looped rings (metropolis: shifts
+            # {0, 1, n-1}) stay on the ppermute path at any n.
             limit = (None if g.comm_impl == "shift"
-                     else max(2, w // 2) + (1 if has_dropout else 0))
+                     else max(3, w // 2) + (1 if has_dropout else 0))
             ids = (schedule_shift_decomposition(self.mixing, max_shifts=limit,
                                                 extra_shifts=extra)
                    if (flat_1d and one_worker_per_device) else None)

@@ -194,36 +194,8 @@ class OracleWorker:
         bw [S,B] padding weights.  Returns mean loss."""
         if self.algorithm == "scaffold" and c_global is None:
             raise ValueError("scaffold local_update requires c_global")
-        losses = []
-        theta_t = ({k: v.detach().clone() for k, v in theta.items()}
-                   if theta is not None else None)
-        for s in range(bx.shape[0]):
-            x = torch.from_numpy(np.ascontiguousarray(bx[s]))
-            y = torch.from_numpy(np.ascontiguousarray(by[s])).long()
-            w = torch.from_numpy(np.ascontiguousarray(bw[s]))
-            self.optimizer.zero_grad()
-            out = self.model(x)
-            per = F.cross_entropy(out, y, reduction="none")
-            loss = (per * w).sum() / w.sum().clamp(min=1.0)
-            if self.l2:
-                loss = loss + 0.5 * self.l2 * sum(
-                    (p ** 2).sum() for p in self.model.parameters())
-            loss.backward()
-            if self.algorithm in ("fedprox", "fedadmm"):
-                for n, p in self.model.named_parameters():
-                    if p.grad is None:
-                        continue
-                    extra = self.rho * (p.detach() - theta_t[n])
-                    if self.algorithm == "fedadmm":
-                        extra = extra + self.alpha[n]
-                    p.grad = p.grad + extra
-            elif self.algorithm == "scaffold":
-                for n, p in self.model.named_parameters():
-                    if p.grad is None:
-                        continue
-                    p.grad = p.grad - self.control[n] + c_global[n]
-            self.optimizer.step()
-            losses.append(float(loss.detach()))
+        losses: list[float] = []
+        self._epoch_steps(bx, by, bw, theta, c_global, losses, [0.0, 0.0])
         return float(np.mean(losses))
 
     def inference(self, bx: np.ndarray, by: np.ndarray,
@@ -259,12 +231,12 @@ class OracleWorker:
         local validation stack (vx, vy, vw) is evaluated and a history
         row {train_loss, train_acc, val_acc, val_loss} recorded
         (val_loss in the P1 'sum' or P2 'mean' flavour)."""
+        if self.algorithm == "scaffold" and c_global is None:
+            raise ValueError("scaffold local_update requires c_global")
         rows = []
         for e in range(bx.shape[0]):
             correct_total = [0.0, 0.0]
             losses: list[float] = []
-            # reuse the flat-step path for one epoch's steps, tracking
-            # train metrics per step
             loss_mean = self._epoch_steps(bx[e], by[e], bw[e], theta,
                                           c_global, losses, correct_total)
             vacc, vsum, vmean = self.inference(vx, vy, vw)
@@ -279,9 +251,11 @@ class OracleWorker:
 
     def _epoch_steps(self, bx, by, bw, theta, c_global, losses,
                      correct_total) -> float:
-        """One epoch of SGD steps ([S, B, ...]), accumulating per-batch
-        losses and the weighted correct count; returns the epoch's mean
-        batch loss (``sum(train_loss)/len(train_loss)``)."""
+        """One run of SGD steps over a [S, B, ...] stack (the shared
+        training body of ``local_update`` and ``local_update_epochs``),
+        appending per-batch losses and accumulating the weighted correct
+        count into ``correct_total``; returns the mean batch loss
+        (``sum(train_loss)/len(train_loss)``)."""
         theta_t = ({k: v.detach().clone() for k, v in theta.items()}
                    if theta is not None else None)
         for s in range(bx.shape[0]):
@@ -315,8 +289,7 @@ class OracleWorker:
                 pred = out.argmax(dim=1)
                 correct_total[0] += float(((pred == y).float() * w).sum())
                 correct_total[1] += float(w.sum())
-        ep_losses = losses[-bx.shape[0]:]
-        return float(np.mean(ep_losses))
+        return float(np.mean(losses[-bx.shape[0]:]))
 
     def update_duals(self, theta: Mapping) -> None:
         """ADMM dual ascent after the local epochs (clients.py:141-144)."""

@@ -71,8 +71,41 @@ def dense_attention(q, k, v, *, causal: bool = False):
     return jnp.einsum("bqhk,bkhd->bqhd", p, v)
 
 
+def _block_attn_chunked(qb, kb_t, vb_t, *, scale, q_pos, k_pos0, chunk):
+    """Blockwise attention against one KV block, itself scanned in
+    ``chunk``-sized KV slices (flash-style): peak score memory drops
+    from O(Lq·Lk) to O(Lq·chunk) per device without changing the exact
+    result — the running (num, den, max) accumulators combine chunks
+    the same way ring steps combine blocks.  ``q_pos``/``k_pos0`` are
+    global positions for exact cross-chunk causal masking (pass
+    ``q_pos=None`` for non-causal)."""
+    lk = kb_t.shape[1]
+    nchunks = lk // chunk
+    kc = kb_t.reshape(kb_t.shape[0], nchunks, chunk, *kb_t.shape[2:])
+    vc = vb_t.reshape(vb_t.shape[0], nchunks, chunk, *vb_t.shape[2:])
+
+    def chunk_step(carry, ci):
+        num, den, m = carry
+        kb_c = jax.lax.dynamic_index_in_dim(kc, ci, axis=1, keepdims=False)
+        vb_c = jax.lax.dynamic_index_in_dim(vc, ci, axis=1, keepdims=False)
+        if q_pos is not None:
+            k_pos = k_pos0 + ci * chunk + jnp.arange(chunk)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, :, None, :]
+        else:
+            mask = None
+        num2, den2, m2 = _block_attn(qb, kb_c, vb_c, scale=scale, mask=mask)
+        return _combine(num, den, m, num2, den2, m2), None
+
+    num0 = qb * 0
+    den0 = jnp.sum(num0, axis=-1)
+    m0 = den0 - jnp.inf
+    (num, den, m), _ = jax.lax.scan(chunk_step, (num0, den0, m0),
+                                    jnp.arange(nchunks))
+    return num, den, m
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
-                   axis: str = SEQ_AXIS):
+                   axis: str = SEQ_AXIS, kv_chunk: int | None = None):
     """Exact attention with the sequence axis sharded over ``mesh``.
 
     q, k, v: [B, L, H, Dh] global-view arrays (L divisible by the mesh
@@ -81,13 +114,21 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
     ``ppermute`` hops, each overlapping the local blockwise attention.
     Causal masking is exact across blocks: query block i attends to key
     block j at full, diagonal, or zero visibility depending on i vs j.
+
+    ``kv_chunk`` additionally scans each block's KV in chunks of that
+    size (must divide the block), bounding per-device score memory at
+    O(block · kv_chunk) instead of O(block²) — the knob that takes one
+    device's block past what a materialised attention matrix allows.
     """
     n = mesh.shape[axis]
     l = q.shape[1]
     if l % n:
         raise ValueError(f"sequence length {l} not divisible by mesh axis {n}")
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     block = l // n
+    if kv_chunk is not None and (kv_chunk <= 0 or block % kv_chunk):
+        raise ValueError(f"kv_chunk {kv_chunk} must divide the per-device "
+                         f"block {block}")
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
 
     def local(qb, kb, vb):
         # qb/kb/vb: [B, block, H, Dh] — this device's shard.
@@ -98,14 +139,20 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
             kv, num, den, m = carry
             kb_t, vb_t = kv
             kv_idx = (my + t) % n               # which key block we hold now
-            if causal:
-                k_pos = kv_idx * block + jnp.arange(block)
-                mask = q_pos[:, None] >= k_pos[None, :]     # [block, block]
-                mask = mask[None, :, None, :]               # [1, Lq, 1, Lk]
+            if kv_chunk is not None:
+                num2, den2, m2 = _block_attn_chunked(
+                    qb, kb_t, vb_t, scale=scale,
+                    q_pos=q_pos if causal else None,
+                    k_pos0=kv_idx * block, chunk=kv_chunk)
             else:
-                mask = None
-            num2, den2, m2 = _block_attn(qb, kb_t, vb_t, scale=scale,
-                                         mask=mask)
+                if causal:
+                    k_pos = kv_idx * block + jnp.arange(block)
+                    mask = q_pos[:, None] >= k_pos[None, :]  # [block, block]
+                    mask = mask[None, :, None, :]            # [1, Lq, 1, Lk]
+                else:
+                    mask = None
+                num2, den2, m2 = _block_attn(qb, kb_t, vb_t, scale=scale,
+                                             mask=mask)
             num, den, m = _combine(num, den, m, num2, den2, m2)
 
             # Rotate KV to the next device — except after the last

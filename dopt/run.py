@@ -78,8 +78,10 @@ def apply_override(cfg, spec: str):
 
 
 def build_trainer(cfg):
-    from dopt.engine import FederatedTrainer, GossipTrainer
+    from dopt.engine import FederatedTrainer, GossipTrainer, SeqLMTrainer
 
+    if cfg.seqlm is not None:
+        return SeqLMTrainer(cfg)
     if cfg.federated is not None:
         return FederatedTrainer(cfg)
     return GossipTrainer(cfg)
@@ -148,8 +150,12 @@ def main(argv: list[str] | None = None) -> int:
 
     rounds = args.rounds
     if rounds is None:
-        rounds = (cfg.federated.rounds if cfg.federated is not None
-                  else cfg.gossip.rounds)
+        if cfg.seqlm is not None:
+            rounds = cfg.seqlm.steps
+        elif cfg.federated is not None:
+            rounds = cfg.federated.rounds
+        else:
+            rounds = cfg.gossip.rounds
     if args.trace:
         from dopt.utils.profiling import trace
 

@@ -1,0 +1,66 @@
+"""Sequence-parallel LM throughput: tokens/sec on the current device(s).
+
+Measures steady-state training throughput of the ``seqlm`` preset
+(decoder-only TransformerLM, ring attention, sequence axis sharded over
+all devices).  On a single chip the ring degenerates to one block (same
+code path, no hops); on an N-device mesh the KV pairs rotate over ICI.
+There is no reference counterpart (the reference has no sequence axis);
+the number is the framework's own long-context baseline.
+
+Usage: python scripts/bench_seqlm.py [--steps N] [--seq-len L] [--attn ring]
+Prints one JSON line: {"metric": "seqlm_tokens_per_sec", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    args = ap.parse_args()
+
+    import jax
+
+    from dopt.engine import SeqLMTrainer
+    from dopt.presets import get_preset
+
+    cfg = get_preset("seqlm")
+    cfg = cfg.replace(seqlm=dataclasses.replace(
+        cfg.seqlm, steps=args.steps, seq_len=args.seq_len, batch=args.batch,
+        attn=args.attn, log_every=max(args.steps // 3, 1)))
+    tr = SeqLMTrainer(cfg)
+    tr.run(steps=3)                       # compile + warmup
+    t0 = time.time()
+    tr.run(steps=args.steps)
+    jax.block_until_ready(tr.params)
+    elapsed = time.time() - t0
+    tokens = args.steps * args.batch * args.seq_len
+    print(json.dumps({
+        "metric": "seqlm_tokens_per_sec",
+        "value": round(tokens / elapsed, 1),
+        "unit": "tokens/sec",
+        "attn": args.attn,
+        "seq_len": args.seq_len,
+        "batch": args.batch,
+        "mesh_devices": tr.mesh.size,
+        "params": tr.param_count,
+        "final_loss": round(tr.history.last()["loss"], 4),
+        "device": str(jax.devices()[0].device_kind),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

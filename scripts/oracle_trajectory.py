@@ -88,15 +88,16 @@ def _divergence(trainer_params, workers) -> tuple[float, float]:
     return worst, float(np.sqrt(num / max(den, 1e-30)))
 
 
-def gossip_trajectory(topology: str, mode: str, rounds: int) -> dict:
+def gossip_trajectory(topology: str, mode: str, rounds: int,
+                      local_ep: int = 1) -> dict:
     cfg = _base_cfg(
         f"traj-dsgd-{topology}-{mode}",
         gossip=GossipConfig(algorithm="dsgd", topology=topology, mode=mode,
-                            rounds=rounds, local_ep=1, local_bs=BS),
+                            rounds=rounds, local_ep=local_ep, local_bs=BS),
     )
     tr = GossipTrainer(cfg)
     init = jax.device_get(jax.tree.map(lambda x: x[0], tr.params))
-    mixing, index_matrix, ds = tr.mixing, tr.index_matrix, tr.dataset
+    mixing, index_matrix, ds = tr.mixing, tr._train_matrix, tr.dataset
     workers = _workers(init)
 
     diffs = []
@@ -109,8 +110,8 @@ def gossip_trajectory(topology: str, mode: str, rounds: int) -> dict:
                for i in range(N_WORKERS)]
         for wk, st in zip(workers, new):
             wk.load(st)
-        plan = make_batch_plan(index_matrix, batch_size=BS, local_ep=1,
-                               seed=SEED, round_idx=t)
+        plan = make_batch_plan(index_matrix, batch_size=BS,
+                               local_ep=local_ep, seed=SEED, round_idx=t)
         bx, by, bw = gather_batches(ds.train_x, ds.train_y, plan)
         for i, wk in enumerate(workers):
             wk.local_update(nhwc_to_nchw(bx[i]), by[i], bw[i])
@@ -120,31 +121,42 @@ def gossip_trajectory(topology: str, mode: str, rounds: int) -> dict:
             "rel_l2_per_round": [round(r, 8) for _, r in diffs]}
 
 
-def federated_trajectory(algorithm: str, rounds: int, frac: float = 0.5) -> dict:
-    cfg = _base_cfg(
+def federated_trajectory(algorithm: str, rounds: int, frac: float = 0.5,
+                         cfg: ExperimentConfig | None = None) -> dict:
+    cfg = cfg or _base_cfg(
         f"traj-{algorithm}",
         federated=FederatedConfig(algorithm=algorithm, frac=frac,
                                   rounds=rounds, local_ep=1, local_bs=BS),
     )
+    frac = cfg.federated.frac
+    local_ep = cfg.federated.local_ep
+    bs = cfg.federated.local_bs
+    n = cfg.data.num_users
+    lr, mom, rho = cfg.optim.lr, cfg.optim.momentum, cfg.optim.rho
     tr = FederatedTrainer(cfg)
     init = jax.device_get(tr.theta)
-    index_matrix, ds = tr.index_matrix, tr.dataset
-    workers = _workers(init, algorithm={"fedavg": "sgd"}.get(algorithm,
-                                                             algorithm))
+    index_matrix, ds = tr._train_matrix, tr.dataset
+    workers = []
+    for _ in range(n):
+        tm = torch_reference_cnn(1, 28, 512, faithful=True)
+        tm.load_state_dict(flax_cnn_params_to_torch(init, 28))
+        workers.append(OracleWorker(
+            tm, lr=lr, momentum=mom, rho=rho,
+            algorithm={"fedavg": "sgd"}.get(algorithm, algorithm)))
     import torch
 
     theta_t = {k: v.clone() for k, v in
                flax_cnn_params_to_torch(init, 28).items()}
     # Same sampling stream as FederatedTrainer._sample_indices.
-    rng = host_rng(SEED, 314159)
+    rng = host_rng(cfg.seed, 314159)
 
     diffs = []
     for t in range(rounds):
         tr.run(rounds=1)
-        m = max(int(frac * N_WORKERS), 1)
-        sel = np.sort(rng.choice(N_WORKERS, m, replace=False))
-        plan = make_batch_plan(index_matrix, batch_size=BS, local_ep=1,
-                               seed=SEED, round_idx=t)
+        m = max(int(frac * n), 1)
+        sel = np.sort(rng.choice(n, m, replace=False))
+        plan = make_batch_plan(index_matrix, batch_size=bs,
+                               local_ep=local_ep, seed=cfg.seed, round_idx=t)
         bx, by, bw = gather_batches(ds.train_x, ds.train_y, plan)
         for i in sel:
             wk = workers[i]
@@ -171,9 +183,31 @@ def federated_trajectory(algorithm: str, rounds: int, frac: float = 0.5) -> dict
             "final_theta_absdiff": round(theta_diff, 8)}
 
 
+def reference_shaped_federated(rounds: int = 20) -> ExperimentConfig:
+    """The P1 notebook config's SHAPE (20 rounds, local_ep=10,
+    local_bs=50, lr=0.1, momentum=0.5, IID, deterministic 90/10 local
+    holdout — cells 8/10) subsampled to 10 users / frac 0.3 so the
+    sequential 1-core torch oracle stays feasible (VERDICT r1 #7)."""
+    return ExperimentConfig(
+        name="traj-reference-fedavg-shape", seed=SEED,
+        data=DataConfig(dataset="synthetic", num_users=10, iid=True,
+                        synthetic_train_size=1000, synthetic_test_size=64,
+                        local_holdout=0.1, holdout_mode="deterministic"),
+        model=ModelConfig(model="model1", input_shape=(28, 28, 1),
+                          faithful=True),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5, rho=0.1),
+        federated=FederatedConfig(algorithm="fedavg", frac=0.3,
+                                  rounds=rounds, local_ep=10, local_bs=50),
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--long", action="store_true",
+                    help="add the long-horizon reference-shaped runs: "
+                         "20-round federated (local_ep=10, bs=50, lr=0.1, "
+                         "90/10 holdout) and 12-round multi-epoch gossip")
     ap.add_argument("--out", default="results/oracle_trajectory.json")
     args = ap.parse_args()
 
@@ -190,10 +224,21 @@ def main() -> int:
         results.append(r)
         print(f"{r['config']}: rel_l2 {max(r['rel_l2_per_round'])} "
               f"(theta absdiff {r['final_theta_absdiff']})")
+    if args.long:
+        r = gossip_trajectory("circle", "stochastic", 12, local_ep=2)
+        r["config"] += "-12r-2ep"
+        results.append(r)
+        print(f"{r['config']}: rel_l2 {max(r['rel_l2_per_round'])}")
+        r = federated_trajectory("fedavg", 20,
+                                 cfg=reference_shaped_federated(20))
+        results.append(r)
+        print(f"{r['config']}: rel_l2 {max(r['rel_l2_per_round'])} "
+              f"(theta absdiff {r['final_theta_absdiff']})")
 
     worst = max(max(r["rel_l2_per_round"]) for r in results)
     payload = {"suite": "oracle trajectory parity",
                "workers": N_WORKERS, "rounds": args.rounds,
+               "long_horizon": args.long,
                "worst_rel_l2": worst, "results": results}
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

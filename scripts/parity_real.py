@@ -1,0 +1,120 @@
+"""Real-data accuracy parity vs the reference's published numbers.
+
+The replay grid (``scripts/replay_reference.py``) proves the machinery
+end-to-end but runs on synthetic data in this egress-free environment,
+so its absolute accuracies are not comparable to the reference's
+committed results.  THIS script is the quantitative parity harness: if
+raw MNIST is available (IDX files under ``$DOPT_DATA_DIR`` — see
+``dopt/data/datasets.py`` for the accepted layouts), it replays the
+reference's experiments on the real data and asserts the headline
+numbers from BASELINE.md within tolerance:
+
+* P1 federated trio (100 users, frac 0.1, 20 rounds, IID, seed 2022 —
+  ``Primal and Dual Decomposition.ipynb`` cells 8-25):
+  FedAvg 97.82%, FedProx 97.68%, FedADMM 97.47% (abs tol 1.5pt —
+  run-to-run seed/order effects; the reference's own reruns vary ~1pt).
+* P2 gossip grid (6 users, 10 rounds, non-IID shards 2, seed 2028 —
+  ``Weighted Average.ipynb`` cells 14-36): the qualitative ordering
+  star < circle < complete for stochastic mixing, complete-stochastic
+  >= 0.70 (reference 0.82), no-consensus-non-IID <= 0.35 (reference
+  0.23), centralized >= 0.95 (reference 0.97).  Gossip runs are
+  chaotic under the faithful double-softmax objective, so the grid is
+  asserted on ordering + bands, not point values.
+
+Without raw data it exits 0 with ``skipped: no real data`` so CI can
+always invoke it — a skip is visible, not a silent pass.
+
+Usage: python scripts/parity_real.py [--fed-only|--gossip-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def have_real_mnist() -> bool:
+    from dopt.data import load_dataset
+
+    try:
+        ds = load_dataset("mnist", synthetic_fallback=False)
+    except (FileNotFoundError, ValueError):
+        return False
+    return ds.train_x.shape[0] >= 60_000
+
+
+def run_preset(name: str):
+    from dopt.presets import get_preset
+    from dopt.run import build_trainer
+
+    trainer = build_trainer(get_preset(name))
+    trainer.run()
+    return trainer.history.last()
+
+
+def check(rows: list[dict], name: str, ok: bool, detail: str) -> None:
+    rows.append({"check": name, "ok": bool(ok), "detail": detail})
+    print(f"{'PASS' if ok else 'FAIL'}  {name:40s} {detail}", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fed-only", action="store_true")
+    ap.add_argument("--gossip-only", action="store_true")
+    ap.add_argument("--out", default="results/parity_real.json")
+    args = ap.parse_args()
+
+    if not have_real_mnist():
+        print("skipped: no real data (set DOPT_DATA_DIR to raw MNIST IDX "
+              "files to run the quantitative parity harness)")
+        return 0
+
+    rows: list[dict] = []
+
+    if not args.gossip_only:
+        # P1 trio — point values from the notebook cell outputs
+        # (BASELINE.md rows 1-3).
+        for preset, ref in (("reference-fedavg", 0.9782),
+                            ("reference-fedprox", 0.9768),
+                            ("reference-fedadmm", 0.9747)):
+            last = run_preset(preset)
+            acc = float(last["test_acc"])
+            check(rows, f"{preset} final acc", abs(acc - ref) <= 0.015,
+                  f"got {acc:.4f}, reference {ref:.4f} (tol 1.5pt)")
+
+    if not args.fed_only:
+        accs = {}
+        for preset in ("reference-centralized", "reference-nocons-noniid",
+                       "reference-dsgd-star", "reference-dsgd-circle",
+                       "reference-dsgd-complete"):
+            last = run_preset(preset)
+            accs[preset] = float(last["avg_test_acc"])
+        check(rows, "centralized band", accs["reference-centralized"] >= 0.95,
+              f"got {accs['reference-centralized']:.4f}, reference 0.97")
+        check(rows, "nocons non-IID collapses",
+              accs["reference-nocons-noniid"] <= 0.35,
+              f"got {accs['reference-nocons-noniid']:.4f}, reference 0.23")
+        check(rows, "ordering star < circle < complete",
+              accs["reference-dsgd-star"] < accs["reference-dsgd-circle"]
+              < accs["reference-dsgd-complete"],
+              f"star {accs['reference-dsgd-star']:.3f} / circle "
+              f"{accs['reference-dsgd-circle']:.3f} / complete "
+              f"{accs['reference-dsgd-complete']:.3f}")
+        check(rows, "complete-stochastic band",
+              accs["reference-dsgd-complete"] >= 0.70,
+              f"got {accs['reference-dsgd-complete']:.4f}, reference 0.82")
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(rows, indent=2) + "\n")
+    failed = [r for r in rows if not r["ok"]]
+    print(f"{len(rows) - len(failed)}/{len(rows)} checks passed; wrote {out}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
