@@ -228,7 +228,11 @@ class ExperimentConfig:
     federated: FederatedConfig | None = None
     gossip: GossipConfig | None = None
     seqlm: SeqLMConfig | None = None
-    # Execution backend: "jax" (TPU/mesh path) or "torch" (faithful CPU oracle).
+    # Execution backend — the pluggable Worker(backend=...) boundary:
+    # "jax" runs the TPU/mesh engines; "torch" runs the SAME experiment
+    # on the faithful sequential CPU oracle (dopt.engine.torch_backend)
+    # — identical init, plans, sampling streams, holdout — for
+    # cross-backend trajectory comparison.  Anything else raises.
     backend: str = "jax"
     # Mesh shape: workers are folded onto devices; workers_per_device>1
     # vmaps multiple worker lanes onto one chip (SURVEY §7 hard parts).

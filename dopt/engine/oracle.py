@@ -80,6 +80,70 @@ def torch_reference_cnn(in_channels: int, spatial: int, hidden: int,
     return _Net()
 
 
+def torch_mlp(flat: int, hidden=(200, 200), num_classes: int = 10,
+              faithful: bool = False):
+    """Torch twin of ``dopt.models.zoo.MLP`` (same layer names, so
+    ``flax_dense_params_to_torch`` maps state dicts 1:1).  Input NCHW;
+    only C=1 (or already-flat) inputs flatten identically to the flax
+    NHWC model."""
+
+    class _MLP(nn.Module):
+        def __init__(self):
+            super().__init__()
+            dims = [flat, *hidden]
+            for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+                setattr(self, f"fc{i + 1}", nn.Linear(a, b))
+            self.head = nn.Linear(dims[-1], num_classes)
+            self.n_hidden = len(hidden)
+
+        def forward(self, x):
+            x = x.reshape(x.shape[0], -1)
+            for i in range(self.n_hidden):
+                x = F.relu(getattr(self, f"fc{i + 1}")(x))
+            x = self.head(x)
+            return F.softmax(x, dim=-1) if faithful else x
+
+    return _MLP()
+
+
+def torch_logistic(flat: int, num_classes: int = 2, faithful: bool = False):
+    """Torch twin of ``dopt.models.zoo.LogisticRegression``."""
+
+    class _Log(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.linear = nn.Linear(flat, num_classes)
+
+        def forward(self, x):
+            x = self.linear(x.reshape(x.shape[0], -1))
+            return F.softmax(x, dim=-1) if faithful else x
+
+    return _Log()
+
+
+def flax_dense_params_to_torch(params: Mapping) -> dict:
+    """Dense-only flax tree {name: {kernel, bias}} → torch state_dict
+    {name.weight, name.bias} (kernel [in, out] → weight [out, in])."""
+    out = {}
+    for name, leaf in params.items():
+        out[f"{name}.weight"] = torch.from_numpy(
+            np.asarray(leaf["kernel"]).T.copy())
+        out[f"{name}.bias"] = torch.from_numpy(np.asarray(leaf["bias"]).copy())
+    return out
+
+
+def torch_dense_params_to_flax(state: Mapping) -> dict:
+    """Inverse of ``flax_dense_params_to_torch``."""
+    out: dict = {}
+    for key, v in state.items():
+        name, kind = key.rsplit(".", 1)
+        leaf = out.setdefault(name, {})
+        arr = v.detach().cpu().numpy()
+        leaf["kernel" if kind == "weight" else "bias"] = (
+            arr.T.copy() if kind == "weight" else arr.copy())
+    return out
+
+
 # ---------------------------------------------------------------------
 # Parameter conversion (flax pytree <-> torch state_dict)
 # ---------------------------------------------------------------------

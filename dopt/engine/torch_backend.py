@@ -1,0 +1,426 @@
+"""The faithful torch-CPU reference backend as driveable trainers.
+
+``ExperimentConfig.backend="torch"`` selects this module: the
+reference's EXECUTION MODEL — N torch workers stepped sequentially in
+one process, communication as state-dict passing — run end-to-end
+behind the same trainer surface (``run``, ``history``,
+``client_history``, ``evaluate``) as the jax engines.  This is the
+pluggable ``Worker(backend=...)`` boundary of the build plan (SURVEY
+§7 step 4): ``backend="jax"`` is the TPU path, ``backend="torch"`` is
+the numerics oracle, and experiments swap between them with one config
+field.
+
+Everything that defines the experiment is SHARED with the jax engines —
+dataset loading, partitioning, the 90/10 local holdout, deterministic
+batch plans, mixing-matrix schedules, client-sampling RNG streams, and
+the flax parameter initialisation (converted to torch state dicts) — so
+the two backends consume byte-identical inputs and their trajectories
+are directly comparable (tests/test_torch_backend.py pins this).
+
+Scope: the reference's surface.  Models: model1 / model3 (the reference
+CNNs) plus the dense zoo extras (mlp, logistic).  Algorithms: gossip
+dsgd / nocons / fedlcon; federated fedavg / fedprox / fedadmm /
+scaffold.  The TPU-native extras (choco compression, dropout fault
+injection, pairwise gossip matching, resnet18/transformer) have no
+reference execution model to be faithful to and are rejected loudly.
+Checkpointing lives on the jax side only (the oracle is a validation
+backend, not a production trainer) — ``save``/``restore`` raise.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dopt.config import ExperimentConfig
+from dopt.data import (eval_batches, holdout_split, load_dataset,
+                       make_batch_plan, partition, stacked_eval_batches)
+from dopt.engine.local import validate_optimizer
+from dopt.engine.oracle import (HAVE_TORCH, OracleWorker, consensus,
+                                flax_cnn_params_to_torch,
+                                flax_dense_params_to_torch, nhwc_to_nchw,
+                                torch_cnn_params_to_flax,
+                                torch_dense_params_to_flax, torch_logistic,
+                                torch_mlp, torch_reference_cnn)
+from dopt.models import build_model
+from dopt.topology import build_mixing_matrices
+from dopt.utils.metrics import History
+from dopt.utils.profiling import PhaseTimers
+from dopt.utils.prng import host_rng
+
+
+def _build_torch_twin(model_cfg):
+    """(torch module factory, flax→torch, torch→flax) for a zoo model."""
+    name = model_cfg.model.lower()
+    shape = model_cfg.input_shape
+    ncls = model_cfg.num_classes
+    if name in ("model1", "model3"):
+        spatial, in_ch = shape[0], shape[-1]
+        hidden = 512 if name == "model1" else 256
+
+        def make():
+            return torch_reference_cnn(in_ch, spatial, hidden,
+                                       num_classes=ncls,
+                                       faithful=model_cfg.faithful)
+
+        return (make,
+                lambda p: flax_cnn_params_to_torch(p, spatial),
+                lambda s: torch_cnn_params_to_flax(s, spatial))
+    if name in ("mlp", "logistic"):
+        if len(shape) > 1 and shape[-1] != 1:
+            raise ValueError(
+                f"torch backend {name} supports flat or single-channel "
+                f"inputs only (NCHW/NHWC flatten orders differ for "
+                f"C={shape[-1]})")
+        flat = int(np.prod(shape))
+
+        def make():
+            if name == "mlp":
+                return torch_mlp(flat, num_classes=ncls,
+                                 faithful=model_cfg.faithful)
+            return torch_logistic(flat, num_classes=ncls,
+                                  faithful=model_cfg.faithful)
+
+        return make, flax_dense_params_to_torch, torch_dense_params_to_flax
+    raise ValueError(
+        f"model {name!r} has no torch reference twin (the faithful backend "
+        "covers the reference surface: model1|model3|mlp|logistic)")
+
+
+def _layout_converter(model_cfg):
+    """NHWC→NCHW converter for image models; identity for flat-feature
+    models (keyed off the MODEL's input shape — a gathered [W, S, B, F]
+    flat-feature stack is 4-D too, so array rank cannot decide)."""
+    if len(model_cfg.input_shape) >= 3:
+        return nhwc_to_nchw
+    return lambda x: x
+
+
+class _TorchTrainerBase:
+    """Shared setup: data, partition, holdout, eval stacks, torch fleet
+    initialised from the SAME flax init the jax engines use."""
+
+    def __init__(self, cfg: ExperimentConfig, section):
+        if not HAVE_TORCH:  # pragma: no cover - torch is in the image
+            raise RuntimeError("backend='torch' requires torch")
+        validate_optimizer(cfg)
+        self.cfg = cfg
+        self.round = 0
+        self.history = History(cfg.name)
+        self.client_history = History(cfg.name + "-clients")
+        self.timers = PhaseTimers()
+        w = cfg.data.num_users
+        self.num_workers = w
+
+        self.dataset = load_dataset(
+            cfg.data.dataset, data_dir=cfg.data.data_dir,
+            train_size=cfg.data.synthetic_train_size,
+            test_size=cfg.data.synthetic_test_size, seed=cfg.seed,
+        )
+        _, self.index_matrix = partition(
+            self.dataset.train_y, w, iid=cfg.data.iid,
+            shards_per_user=cfg.data.shards, seed=cfg.seed,
+        )
+        self._to_nchw = _layout_converter(cfg.model)
+        self._holdout = cfg.data.local_holdout > 0.0
+        if self._holdout:
+            self._train_matrix, val_matrix = holdout_split(
+                self.index_matrix, fraction=cfg.data.local_holdout,
+                mode=cfg.data.holdout_mode, seed=cfg.seed)
+            vi, vw = stacked_eval_batches(val_matrix,
+                                          batch_size=section.local_bs)
+            self._val_x = self._to_nchw(self.dataset.train_x[vi])  # [W,Sv,Bv,...]
+            self._val_y = self.dataset.train_y[vi]
+            self._val_w = vw
+        else:
+            self._train_matrix = self.index_matrix
+
+        ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
+                                  batch_size=max(section.local_bs, 256))
+        self._eval = (self._to_nchw(ex), ey, ew)
+
+        # Identical init to the jax engines: flax init, converted.
+        fmodel = build_model(cfg.model.model, num_classes=cfg.model.num_classes,
+                             faithful=cfg.model.faithful)
+        params0 = fmodel.init(jax.random.key(cfg.seed),
+                              jnp.zeros((1, *cfg.model.input_shape)))["params"]
+        params0 = jax.device_get(params0)
+        make, self._to_torch, self._to_flax = _build_torch_twin(cfg.model)
+        init_state = self._to_torch(params0)
+        self.workers: list[OracleWorker] = []
+        for _ in range(w):
+            m = make()
+            m.load_state_dict({k: v.clone() for k, v in init_state.items()})
+            self.workers.append(OracleWorker(
+                m, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+                rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
+                algorithm=self._worker_algorithm()))
+        self._init_state = init_state
+
+    def _worker_algorithm(self) -> str:
+        return "sgd"
+
+    # --- shared helpers ----------------------------------------------
+    def _round_batches(self, t: int, worker_ids=None):
+        """NCHW [m, S, B, ...] batch stacks for round t (identical plan
+        to the jax engines — same seed keying)."""
+        plan = make_batch_plan(
+            self._train_matrix, batch_size=self._section().local_bs,
+            local_ep=self._section().local_ep, seed=self.cfg.seed,
+            round_idx=t, impl="numpy",
+            workers=worker_ids,
+        )
+        bx = self._to_nchw(self.dataset.train_x[plan.idx])
+        by = self.dataset.train_y[plan.idx]
+        return bx, by, plan.weight
+
+    def _local_round(self, i: int, bx, by, bw, t: int, *, theta=None,
+                     c_global=None, schema: str = "p2") -> tuple[float, float]:
+        """One worker's local epochs; returns (mean loss, train acc) and,
+        with the holdout on, appends per-epoch client-history rows."""
+        wk = self.workers[i]
+        s = self._section()
+        if self._holdout:
+            e = s.local_ep
+            sp = bx.shape[0] // e
+            rows = wk.local_update_epochs(
+                bx.reshape(e, sp, *bx.shape[1:]),
+                by.reshape(e, sp, *by.shape[1:]),
+                bw.reshape(e, sp, *bw.shape[1:]),
+                self._val_x[i], self._val_y[i], self._val_w[i],
+                theta=theta, c_global=c_global,
+                val_flavor="sum" if schema == "p1" else "mean")
+            for r in rows:
+                if schema == "p1":
+                    self.client_history.append(
+                        global_round=t, epoch=r["epoch"], worker=i,
+                        train_loss=r["train_loss"], train_acc=r["train_acc"],
+                        val_acc=r["val_acc"], val_loss=r["val_loss"])
+                else:
+                    self.client_history.append(
+                        round=t, iter=r["epoch"], worker=i,
+                        train_loss=r["train_loss"], train_acc=r["train_acc"],
+                        val_acc=r["val_acc"], val_loss=r["val_loss"])
+            return (float(np.mean([r["train_loss"] for r in rows])),
+                    float(np.mean([r["train_acc"] for r in rows])))
+        losses: list[float] = []
+        ct = [0.0, 0.0]
+        wk._epoch_steps(bx, by, bw, theta, c_global, losses, ct)
+        return float(np.mean(losses)), ct[0] / max(ct[1], 1.0)
+
+    def save(self, path) -> None:
+        raise ValueError(
+            "backend='torch' is the validation oracle and does not "
+            "checkpoint; use backend='jax' for resumable training")
+
+    restore = save
+
+    def params_as_flax(self):
+        """Stacked [W, ...] flax pytree of the fleet's parameters — the
+        cross-backend comparison hook."""
+        trees = [self._to_flax(wk.model.state_dict()) for wk in self.workers]
+        return jax.tree.map(lambda *xs: np.stack(xs), *trees)
+
+
+class OracleGossipTrainer(_TorchTrainerBase):
+    """Reference project-2 execution: sequential workers, two-phase
+    synchronous consensus → per-client eval → local update
+    (``simulators.py:136-167``)."""
+
+    def __init__(self, cfg: ExperimentConfig):
+        g = cfg.gossip
+        if g is None:
+            raise ValueError("cfg.gossip must be set")
+        if g.algorithm not in ("dsgd", "nocons", "fedlcon"):
+            raise ValueError(
+                f"torch backend supports gossip dsgd|nocons|fedlcon "
+                f"(the reference surface), not {g.algorithm!r}")
+        if g.dropout > 0:
+            raise ValueError("dropout fault injection is a jax-backend "
+                             "feature (the reference has no failures)")
+        super().__init__(cfg, g)
+        self.mixing = (build_mixing_matrices(
+            g.topology, g.mode, self.num_workers, seed=cfg.seed,
+            self_weight=g.self_weight, groups=g.hier_groups,
+            period=g.hier_period)
+            if g.algorithm in ("dsgd", "fedlcon") else None)
+
+    def _section(self):
+        return self.cfg.gossip
+
+    def run(self, rounds: int | None = None, **_) -> History:
+        g = self.cfg.gossip
+        rounds = g.rounds if rounds is None else rounds
+        eps = g.eps if (g.algorithm == "fedlcon"
+                        and not g.faithful_bugs) else 1
+        t0 = time.time()
+        for _ in range(rounds):
+            t = self.round
+            if self.mixing is not None:
+                w_t = self.mixing.for_round(t)
+                for _sweep in range(eps):
+                    states = [wk.state() for wk in self.workers]
+                    new = [consensus([(float(w_t[i, j]), states[j])
+                                      for j in range(self.num_workers)
+                                      if w_t[i, j] > 0])
+                           for i in range(self.num_workers)]
+                    for wk, st in zip(self.workers, new):
+                        wk.load(st)
+            accs, losses_m = [], []
+            for wk in self.workers:
+                a, _s, m = wk.inference(*self._eval)
+                accs.append(a)
+                losses_m.append(m)
+            bx, by, bw = self._round_batches(t)
+            tl, ta = [], []
+            for i in range(self.num_workers):
+                l, a = self._local_round(i, bx[i], by[i], bw[i], t,
+                                         schema="p2")
+                tl.append(l)
+                ta.append(a)
+            self.history.append(
+                round=t, avg_train_loss=float(np.mean(tl)),
+                avg_train_acc=float(np.mean(ta)),
+                avg_test_acc=float(np.mean(accs)),
+                avg_test_loss=float(np.mean(losses_m)),
+            )
+            self.round += 1
+        self.total_time = time.time() - t0
+        return self.history
+
+    def evaluate(self) -> dict[str, np.ndarray]:
+        out = [wk.inference(*self._eval) for wk in self.workers]
+        return {"acc": np.array([o[0] for o in out]),
+                "loss_sum": np.array([o[1] for o in out]),
+                "loss_mean": np.array([o[2] for o in out])}
+
+
+class OracleFederatedTrainer(_TorchTrainerBase):
+    """Reference project-1 execution: server round with client sampling,
+    sequential sampled-client updates, uniform averaging
+    (``servers.py:50-81``), same sampling RNG stream as the jax engine."""
+
+    def __init__(self, cfg: ExperimentConfig):
+        f = cfg.federated
+        if f is None:
+            raise ValueError("cfg.federated must be set")
+        if f.algorithm not in ("fedavg", "fedprox", "fedadmm", "scaffold"):
+            raise ValueError(f"unknown federated algorithm {f.algorithm!r}")
+        super().__init__(cfg, f)
+        import torch
+
+        self._torch = torch
+        self.theta = {k: v.clone() for k, v in self._init_state.items()}
+        self.c_global = ({k: torch.zeros_like(v)
+                          for k, v in self._init_state.items()}
+                         if f.algorithm == "scaffold" else None)
+        self._sample_rng = host_rng(cfg.seed, 314159)
+        # Per-worker train-split eval stacks (avg_trainig_calculator).
+        ti, tw = stacked_eval_batches(self._train_matrix,
+                                      batch_size=max(f.local_bs, 256))
+        self._train_eval = (self._to_nchw(self.dataset.train_x[ti]),
+                            self.dataset.train_y[ti], tw)
+
+    def _section(self):
+        return self.cfg.federated
+
+    def _worker_algorithm(self) -> str:
+        return {"fedavg": "sgd"}.get(self.cfg.federated.algorithm,
+                                     self.cfg.federated.algorithm)
+
+    def run(self, frac: float | None = None, rounds: int | None = None,
+            **_) -> History:
+        f = self.cfg.federated
+        torch = self._torch
+        frac = f.frac if frac is None else frac
+        rounds = f.rounds if rounds is None else rounds
+        algo = f.algorithm
+        t0 = time.time()
+        for _ in range(rounds):
+            t = self.round
+            m = max(int(frac * self.num_workers), 1)
+            sel = np.sort(self._sample_rng.choice(self.num_workers, m,
+                                                  replace=False))
+            bx, by, bw = self._round_batches(t, worker_ids=sel)
+            local_losses = []
+            theta_named = {k: v for k, v in self.theta.items()}
+            # Round-start snapshot: every sampled worker trains against
+            # (and refreshes its control from) the SAME server control c,
+            # and the accumulated delta lands once after the loop —
+            # matching the jax engine's control_delta semantics.
+            c_round = ({k: v.clone() for k, v in self.c_global.items()}
+                       if algo == "scaffold" else None)
+            for j, i in enumerate(sel):
+                wk = self.workers[i]
+                wk.load(self.theta)
+                if algo == "scaffold":
+                    # Fresh momentum each round (matches the jax engine's
+                    # scaffold semantics: theta − y reflects only this
+                    # round's gradients).
+                    wk.optimizer.state.clear()
+                needs_theta = algo in ("fedprox", "fedadmm")
+                l, _a = self._local_round(
+                    int(i), bx[j], by[j], bw[j], t,
+                    theta=theta_named if needs_theta else None,
+                    c_global=c_round, schema="p1")
+                local_losses.append(l)
+                if algo == "fedadmm":
+                    wk.update_duals(theta_named)
+                elif algo == "scaffold":
+                    steps = bw.shape[1]
+                    lr_eff = self.cfg.optim.lr / max(
+                        1.0 - self.cfg.optim.momentum, 1e-8)
+                    delta = wk.update_controls(theta_named, c_round,
+                                               lr_eff, steps)
+                    with torch.no_grad():
+                        for k in self.c_global:
+                            self.c_global[k] += delta[k] / self.num_workers
+            with torch.no_grad():
+                states = [self.workers[i].state() for i in sel]
+                self.theta = {k: sum(st[k] for st in states) / len(states)
+                              for k in self.theta}
+            # Global test eval + all-client train eval.
+            probe = self.workers[0]
+            saved = probe.state()
+            probe.load(self.theta)
+            acc, loss_sum, _lm = probe.inference(*self._eval)
+            probe.load(saved)
+            tl, ta = [], []
+            for i, wk in enumerate(self.workers):
+                a, _s, lm = wk.inference(self._train_eval[0][i],
+                                         self._train_eval[1][i],
+                                         self._train_eval[2][i])
+                tl.append(lm)
+                ta.append(a)
+            self.history.append(
+                round=t, test_acc=float(acc), test_loss=float(loss_sum),
+                train_loss=float(np.mean(tl)), train_acc=float(np.mean(ta)),
+                local_loss=float(np.mean(local_losses)),
+            )
+            self.round += 1
+        self.total_time = time.time() - t0
+        return self.history
+
+    def theta_as_flax(self):
+        return self._to_flax(self.theta)
+
+    def evaluate_global(self) -> dict[str, float]:
+        probe = self.workers[0]
+        saved = probe.state()
+        probe.load(self.theta)
+        acc, loss_sum, loss_mean = probe.inference(*self._eval)
+        probe.load(saved)
+        return {"acc": acc, "loss_sum": loss_sum, "loss_mean": loss_mean}
+
+
+def build_torch_trainer(cfg: ExperimentConfig):
+    """backend='torch' factory (mirrors ``dopt.run.build_trainer``)."""
+    if cfg.seqlm is not None:
+        raise ValueError("seqlm has no torch reference backend (the "
+                         "reference has no sequence axis)")
+    if cfg.federated is not None:
+        return OracleFederatedTrainer(cfg)
+    return OracleGossipTrainer(cfg)

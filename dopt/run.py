@@ -78,6 +78,14 @@ def apply_override(cfg, spec: str):
 
 
 def build_trainer(cfg):
+    if cfg.backend not in ("jax", "torch"):
+        raise ValueError(
+            f"unknown backend {cfg.backend!r}; 'jax' (TPU/mesh engines) or "
+            "'torch' (the sequential reference oracle)")
+    if cfg.backend == "torch":
+        from dopt.engine.torch_backend import build_torch_trainer
+
+        return build_torch_trainer(cfg)
     from dopt.engine import FederatedTrainer, GossipTrainer, SeqLMTrainer
 
     if cfg.seqlm is not None:

@@ -1,0 +1,163 @@
+"""backend='torch': the sequential reference oracle as a driveable
+trainer, compared trajectory-for-trajectory against the jax engines on
+identical inputs (same flax init, plans, sampling streams, holdout)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+import dopt
+from dopt.run import build_trainer
+
+
+def _gossip(backend, algorithm="dsgd", holdout=0.0, **gkw):
+    g = dict(algorithm=algorithm, topology="circle", mode="uniform",
+             rounds=3, local_ep=1, local_bs=32)
+    g.update(gkw)
+    return dopt.ExperimentConfig(
+        name="tb", seed=11, backend=backend,
+        data=dopt.DataConfig(dataset="synthetic", num_users=4, iid=False,
+                             shards=2, synthetic_train_size=256,
+                             synthetic_test_size=64, local_holdout=holdout,
+                             holdout_mode="random"),
+        model=dopt.ModelConfig(model="mlp", faithful=False),
+        optim=dopt.OptimizerConfig(lr=0.05, momentum=0.5),
+        gossip=dopt.GossipConfig(**g),
+    )
+
+
+def _fed(backend, algorithm="fedavg", holdout=0.0):
+    return dopt.ExperimentConfig(
+        name="tb", seed=11, backend=backend,
+        data=dopt.DataConfig(dataset="synthetic", num_users=4, iid=True,
+                             synthetic_train_size=256, synthetic_test_size=64,
+                             local_holdout=holdout),
+        model=dopt.ModelConfig(model="mlp", faithful=False),
+        optim=dopt.OptimizerConfig(lr=0.05, momentum=0.5, rho=0.2),
+        federated=dopt.FederatedConfig(algorithm=algorithm, frac=0.5,
+                                       rounds=3, local_ep=2, local_bs=32),
+    )
+
+
+def _max_rel(tree_a, tree_b):
+    la = sorted(jax.tree.leaves(tree_a), key=lambda x: x.shape)
+    lb = sorted(jax.tree.leaves(tree_b), key=lambda x: x.shape)
+    return max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max()
+              / max(float(np.abs(np.asarray(a)).max()), 1e-9))
+        for a, b in zip(la, lb))
+
+
+def test_gossip_trajectory_matches_jax(devices):
+    tj = build_trainer(_gossip("jax"))
+    tt = build_trainer(_gossip("torch"))
+    hj, ht = tj.run(), tt.run()
+    for rj, rt in zip(hj.rows, ht.rows):
+        assert rj["avg_test_acc"] == pytest.approx(rt["avg_test_acc"],
+                                                   abs=1e-4)
+        assert rj["avg_train_loss"] == pytest.approx(rt["avg_train_loss"],
+                                                     abs=1e-3)
+    assert _max_rel(jax.device_get(tj.params), tt.params_as_flax()) < 1e-4
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox", "fedadmm",
+                                       "scaffold"])
+def test_federated_trajectory_matches_jax(devices, algorithm):
+    fj = build_trainer(_fed("jax", algorithm))
+    ft = build_trainer(_fed("torch", algorithm))
+    hj, ht = fj.run(), ft.run()
+    for rj, rt in zip(hj.rows, ht.rows):
+        assert rj["test_acc"] == pytest.approx(rt["test_acc"], abs=1e-3)
+        assert rj["local_loss"] == pytest.approx(rt["local_loss"], abs=2e-3)
+    assert _max_rel(jax.device_get(fj.theta), ft.theta_as_flax()) < 5e-4
+
+
+def test_holdout_client_history_matches_jax(devices):
+    fj = build_trainer(_fed("jax", holdout=0.1))
+    ft = build_trainer(_fed("torch", holdout=0.1))
+    fj.run(), ft.run()
+    assert len(fj.client_history.rows) == len(ft.client_history.rows) > 0
+    for rj, rt in zip(fj.client_history.rows, ft.client_history.rows):
+        assert (rj["global_round"], rj["epoch"], rj["worker"]) == \
+            (rt["global_round"], rt["epoch"], rt["worker"])
+        for k in ("train_loss", "train_acc", "val_acc", "val_loss"):
+            assert rj[k] == pytest.approx(rt[k], abs=2e-3), (k, rj, rt)
+
+
+def test_gossip_holdout_client_history_matches_jax(devices):
+    tj = build_trainer(_gossip("jax", holdout=0.1, local_ep=2))
+    tt = build_trainer(_gossip("torch", holdout=0.1, local_ep=2))
+    tj.run(), tt.run()
+    assert len(tj.client_history.rows) == len(tt.client_history.rows) > 0
+    for rj, rt in zip(tj.client_history.rows, tt.client_history.rows):
+        assert (rj["round"], rj["iter"], rj["worker"]) == \
+            (rt["round"], rt["iter"], rt["worker"])
+        # all four metric keys, pinning the P2 mean-per-batch val flavour
+        for k in ("train_loss", "train_acc", "val_acc", "val_loss"):
+            assert rj[k] == pytest.approx(rt[k], abs=2e-3), (k, rj, rt)
+
+
+def test_fedlcon_and_nocons_supported(devices):
+    t = build_trainer(_gossip("torch", algorithm="fedlcon", eps=2))
+    assert len(t.run(rounds=2)) == 2
+    t = build_trainer(_gossip("torch", algorithm="nocons"))
+    assert len(t.run(rounds=2)) == 2
+
+
+def test_torch_backend_validation(devices):
+    with pytest.raises(ValueError, match="dsgd|nocons|fedlcon"):
+        build_trainer(_gossip("torch", algorithm="choco"))
+    with pytest.raises(ValueError, match="dropout"):
+        build_trainer(_gossip("torch", dropout=0.5))
+    with pytest.raises(ValueError, match="backend"):
+        build_trainer(_gossip("tensorflow"))
+    cfg = _gossip("torch")
+    cfg = cfg.replace(model=dataclasses.replace(cfg.model, model="resnet18"))
+    with pytest.raises(ValueError, match="torch reference twin"):
+        build_trainer(cfg)
+    with pytest.raises(ValueError, match="checkpoint"):
+        build_trainer(_gossip("torch")).save("/tmp/nope")
+    cfg = dopt.ExperimentConfig(backend="torch",
+                                seqlm=dopt.SeqLMConfig())
+    with pytest.raises(ValueError, match="seqlm"):
+        build_trainer(cfg)
+
+
+def test_cli_backend_torch(tmp_path, capsys):
+    from dopt.run import main
+
+    rc = main(["--preset", "baseline1", "--rounds", "2",
+               "--synthetic-scale", "0.02", "--set", "backend=torch",
+               "--set", "gossip.local_ep=1",
+               "--csv", str(tmp_path / "h.csv")])
+    assert rc == 0
+    assert (tmp_path / "h.csv").exists()
+    assert '"round": 1' in capsys.readouterr().out
+
+
+def test_flat_feature_models_supported(devices):
+    """a9a-style flat features (the review's repro): logistic on a 1-D
+    input shape must run on backend='torch' without layout mangling and
+    match the jax engine."""
+    def cfg(backend):
+        return dopt.ExperimentConfig(
+            name="tb", seed=5, backend=backend,
+            data=dopt.DataConfig(dataset="a9a", num_users=4, iid=True,
+                                 synthetic_train_size=256,
+                                 synthetic_test_size=64),
+            model=dopt.ModelConfig(model="logistic", num_classes=2,
+                                   input_shape=(123,), faithful=False),
+            optim=dopt.OptimizerConfig(lr=0.05, momentum=0.0,
+                                       weight_decay=1e-4),
+            federated=dopt.FederatedConfig(algorithm="fedavg", frac=1.0,
+                                           rounds=2, local_ep=1, local_bs=32),
+        )
+
+    fj = build_trainer(cfg("jax"))
+    ft = build_trainer(cfg("torch"))
+    hj, ht = fj.run(), ft.run()
+    for rj, rt in zip(hj.rows, ht.rows):
+        assert rj["test_acc"] == pytest.approx(rt["test_acc"], abs=1e-3)
+    assert _max_rel(jax.device_get(fj.theta), ft.theta_as_flax()) < 1e-4
