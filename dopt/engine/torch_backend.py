@@ -166,11 +166,13 @@ class _TorchTrainerBase:
     # --- shared helpers ----------------------------------------------
     def _round_batches(self, t: int, worker_ids=None):
         """NCHW [m, S, B, ...] batch stacks for round t (identical plan
-        to the jax engines — same seed keying)."""
+        to the jax engines — same seed keying AND the same plan_impl, so
+        a native-planner jax run and its torch twin still train on
+        byte-identical batches)."""
         plan = make_batch_plan(
             self._train_matrix, batch_size=self._section().local_bs,
             local_ep=self._section().local_ep, seed=self.cfg.seed,
-            round_idx=t, impl="numpy",
+            round_idx=t, impl=self.cfg.data.plan_impl,
             workers=worker_ids,
         )
         bx = self._to_nchw(self.dataset.train_x[plan.idx])
@@ -231,16 +233,27 @@ class OracleGossipTrainer(_TorchTrainerBase):
     (``simulators.py:136-167``)."""
 
     def __init__(self, cfg: ExperimentConfig):
+        import dataclasses
+
         g = cfg.gossip
         if g is None:
             raise ValueError("cfg.gossip must be set")
-        if g.algorithm not in ("dsgd", "nocons", "fedlcon"):
+        if g.algorithm not in ("dsgd", "nocons", "centralized", "fedlcon"):
             raise ValueError(
-                f"torch backend supports gossip dsgd|nocons|fedlcon "
-                f"(the reference surface), not {g.algorithm!r}")
+                f"torch backend supports gossip dsgd|nocons|centralized|"
+                f"fedlcon (the reference surface), not {g.algorithm!r}")
         if g.dropout > 0:
             raise ValueError("dropout fault injection is a jax-backend "
                              "feature (the reference has no failures)")
+        if g.algorithm == "centralized":
+            # Same frozen-config rewrite as the jax engine (the reference
+            # mutates the SHARED args object, simulators.py:171-173).
+            cfg = cfg.replace(
+                data=dataclasses.replace(cfg.data, num_users=1, iid=True),
+                gossip=dataclasses.replace(g, local_ep=1,
+                                           algorithm="nocons"),
+            )
+            g = cfg.gossip
         super().__init__(cfg, g)
         self.mixing = (build_mixing_matrices(
             g.topology, g.mode, self.num_workers, seed=cfg.seed,
@@ -251,9 +264,15 @@ class OracleGossipTrainer(_TorchTrainerBase):
     def _section(self):
         return self.cfg.gossip
 
-    def run(self, rounds: int | None = None, **_) -> History:
+    def run(self, rounds: int | None = None, eps: int | None = None,
+            **_) -> History:
         g = self.cfg.gossip
         rounds = g.rounds if rounds is None else rounds
+        if eps is not None and eps != g.eps and g.algorithm == "fedlcon":
+            # Mirror the jax engine: eps is config, not a run() knob.
+            raise ValueError("set eps in GossipConfig (static for the "
+                             "jax engine's compilation; kept consistent "
+                             "here)")
         eps = g.eps if (g.algorithm == "fedlcon"
                         and not g.faithful_bugs) else 1
         t0 = time.time()

@@ -41,12 +41,28 @@ class History:
     def last(self) -> dict[str, Any]:
         return self.rows[-1] if self.rows else {}
 
+    # Reference results/*.csv column orders (P2 ``history`` dumps:
+    # round, avg_test_acc, avg_test_loss, avg_train_loss; P1 ``history``:
+    # round, test_acc, test_loss, train_loss, train_acc) — shared columns
+    # are emitted in this order so dopt CSVs diff cleanly against the
+    # reference's committed files; extra columns follow in first-seen
+    # order.
+    _CSV_ORDER = ("round", "avg_test_acc", "avg_test_loss",
+                  "avg_train_loss", "test_acc", "test_loss", "train_loss",
+                  "train_acc")
+
     def to_csv(self, path: str | Path) -> Path:
         """Write rows in the reference results/*.csv layout (leading
-        unnamed index column, then the row keys)."""
+        unnamed index column, then the columns — union over ALL rows,
+        since non-eval rounds carry fewer keys than eval rounds)."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        cols = list(self.rows[0].keys()) if self.rows else []
+        seen: dict[str, None] = {}
+        for r in self.rows:
+            for k in r:
+                seen.setdefault(k)
+        cols = [c for c in self._CSV_ORDER if c in seen]
+        cols += [c for c in seen if c not in cols]
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
             w.writerow([""] + cols)

@@ -170,3 +170,23 @@ def test_client_grid_plot(tmp_path, devices):
     from dopt.utils.metrics import History
     with pytest.raises(ValueError, match="local_holdout"):
         client_grid_plot(History("empty"))
+
+
+def test_csv_column_order_matches_reference_schema(tmp_path):
+    """Shared columns must come out in the reference's committed-CSV
+    order (P2: round, avg_test_acc, avg_test_loss, avg_train_loss) with
+    extras after, and the column set is the union over all rows (rounds
+    without eval carry fewer keys)."""
+    from dopt.utils.metrics import History
+
+    h = History("t")
+    h.append(round=0, avg_train_loss=1.0, avg_train_acc=0.5,
+             avg_test_acc=0.1, avg_test_loss=2.0)
+    h.append(round=1, avg_train_loss=0.9, avg_train_acc=0.6)  # no-eval round
+    p = h.to_csv(tmp_path / "h.csv")
+    header = p.read_text().splitlines()[0]
+    assert header == (",round,avg_test_acc,avg_test_loss,avg_train_loss,"
+                      "avg_train_acc")
+    # round-trip keeps all rows
+    h2 = History.from_csv(p)
+    assert len(h2.rows) == 2

@@ -161,3 +161,28 @@ def test_flat_feature_models_supported(devices):
     for rj, rt in zip(hj.rows, ht.rows):
         assert rj["test_acc"] == pytest.approx(rt["test_acc"], abs=1e-3)
     assert _max_rel(jax.device_get(fj.theta), ft.theta_as_flax()) < 1e-4
+
+
+def test_centralized_and_native_plans_and_eps_guard(devices):
+    # centralized: same frozen-config rewrite as the jax engine
+    t = build_trainer(_gossip("torch", algorithm="centralized"))
+    assert t.num_workers == 1
+    assert len(t.run(rounds=2)) == 2
+    # plan_impl is honored (native stream plans feed the oracle too,
+    # keeping cross-backend batches byte-identical for any impl)
+    cfgn = _gossip("torch")
+    cfgn = cfgn.replace(data=dataclasses.replace(cfgn.data,
+                                                 plan_impl="native"))
+    cfgj = _gossip("jax")
+    cfgj = cfgj.replace(data=dataclasses.replace(cfgj.data,
+                                                 plan_impl="native"))
+    tn = build_trainer(cfgn)
+    tj = build_trainer(cfgj)
+    hn, hj = tn.run(rounds=2), tj.run(rounds=2)
+    for rn, rj in zip(hn.rows, hj.rows):
+        assert rn["avg_test_acc"] == pytest.approx(rj["avg_test_acc"],
+                                                   abs=1e-4)
+    # explicit eps through run() is rejected like the jax engine
+    t = build_trainer(_gossip("torch", algorithm="fedlcon", eps=2))
+    with pytest.raises(ValueError, match="GossipConfig"):
+        t.run(rounds=1, eps=5)
