@@ -280,11 +280,31 @@ class FederatedTrainer:
                 c_global, sub_new, sub_old,
             )
 
+        def pack_host_metrics(local_loss, evalm, trainm, em):
+            """Everything the host reads per round, as ONE flat f32
+            vector — every device→host fetch pays a fixed ~100 ms tunnel
+            round-trip on this hardware, so the round's history metrics
+            (local loss, global eval, worker-mean train eval, and the
+            per-epoch client-history block under the holdout) travel in
+            a single transfer.  Layout (mirrored by
+            ``_unpack_host_metrics``): [local_loss, test_acc,
+            test_loss_sum, mean(train_loss), mean(train_acc)] +
+            4×[lanes·E] em blocks."""
+            parts = [local_loss.reshape(1),
+                     evalm["acc"][None], evalm["loss_sum"][None],
+                     jnp.mean(trainm["loss_mean"])[None],
+                     jnp.mean(trainm["acc"])[None]]
+            if use_holdout:
+                parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
+                          em["val_acc"].ravel(), em["val_loss_sum"].ravel()]
+            return jnp.concatenate([p.astype(jnp.float32) for p in parts])
+
         def finish(new_theta, new_p, new_m, new_duals, new_c, local_loss,
-                   train_x, train_y, ex, ey, ew, tidx, tweight):
+                   em, train_x, train_y, ex, ey, ew, tidx, tweight):
             """Shared round tail: global test eval + all-client train eval
             (``avg_trainig_calculator``) — identical for both execution
-            paths so the history schema can never diverge between them."""
+            paths so the history schema can never diverge between them.
+            The host-facing metrics leave as one packed vector."""
             evalm = global_eval(new_theta, ex, ey, ew)
             if eval_train_flag:
                 tx = train_x[tidx]
@@ -293,8 +313,9 @@ class FederatedTrainer:
             else:
                 trainm = {"acc": jnp.zeros(w), "loss_mean": jnp.zeros(w),
                           "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
-            return (new_theta, new_p, new_m, new_duals, new_c, local_loss,
-                    evalm, trainm)
+            return (new_theta, new_p, new_m, new_duals, new_c,
+                    pack_host_metrics(jnp.asarray(local_loss), evalm,
+                                      trainm, em))
 
         def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
                      train_x, train_y, ex, ey, ew, tidx, tweight, vidx, vw):
@@ -318,9 +339,12 @@ class FederatedTrainer:
             new_theta = masked_average(new_p, mask, mesh=agg_mesh,
                                        comm_dtype=agg_comm)
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
-            return (*finish(new_theta, new_p, new_m, new_duals, new_c,
-                            local_loss, train_x, train_y, ex, ey, ew, tidx,
-                            tweight), em)
+            # Full-width packs ALL W lanes' em rows (gathering the
+            # sampled subset would be a dynamic shape); the host slices
+            # by the round's sample before appending client rows.
+            return finish(new_theta, new_p, new_m, new_duals, new_c,
+                          local_loss, em, train_x, train_y, ex, ey, ew, tidx,
+                          tweight)
 
         # Per-worker train-split eval: every input has a worker axis.
         stacked_eval_perworker = jax.vmap(
@@ -358,9 +382,9 @@ class FederatedTrainer:
             new_p = _scatter(params, sel, p_t)
             new_m = mom if algorithm == "scaffold" else _scatter(mom, sel, m_t)
             new_theta = jax.tree.map(lambda x: x.mean(axis=0), p_t)
-            return (*finish(new_theta, new_p, new_m, new_duals, new_c,
-                            losses.mean(), train_x, train_y, ex, ey, ew, tidx,
-                            tweight), em)
+            return finish(new_theta, new_p, new_m, new_duals, new_c,
+                          losses.mean(), em, train_x, train_y, ex, ey, ew,
+                          tidx, tweight)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
@@ -378,16 +402,16 @@ class FederatedTrainer:
                 def body(carry, xs):
                     th, p, m, d, c = carry
                     gate, idx, bw = xs
-                    th, p, m, d, c, ll, evalm, trainm, em = one_round(
+                    th, p, m, d, c, packed = one_round(
                         th, p, m, d, c, gate, idx, bw,
                         train_x, train_y, ex, ey, ew, tidx, tweight,
                         vidx, vw)
-                    return (th, p, m, d, c), (ll, evalm, trainm, em)
+                    return (th, p, m, d, c), packed
 
-                carry, (lls, evalms, trainms, ems) = jax.lax.scan(
+                carry, packed = jax.lax.scan(
                     body, (theta, params, mom, duals, c_global),
                     (gates, idxs, bws))
-                return (*carry, lls, evalms, trainms, ems)
+                return (*carry, packed)
 
             return jax.jit(block_fn, donate_argnums=(1, 2, 3))
 
@@ -483,8 +507,8 @@ class FederatedTrainer:
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
             fn = self._compact_block_fn if compact else self._block_fn
-            (self.theta, self.params, self.momentum, new_duals, new_c, lls,
-             evalms, trainms, ems) = self.timers.measure(
+            (self.theta, self.params, self.momentum, new_duals, new_c,
+             packed) = self.timers.measure(
                 "round_step", fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
                 gates, idx, bw, self._train_x, self._train_y, *self._eval,
@@ -494,26 +518,23 @@ class FederatedTrainer:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
-            lls = np.asarray(lls)
-            acc = np.asarray(evalms["acc"])
-            loss_sum = np.asarray(evalms["loss_sum"])
-            t_loss = np.asarray(trainms["loss_mean"])
-            t_acc = np.asarray(trainms["acc"])
-            ems = {k_: np.asarray(v) for k_, v in ems.items()}
+            packed = np.asarray(packed)  # ONE device→host fetch per block
+            lanes = len(sels[0]) if compact else self.num_workers
             for j, t in enumerate(ts):
+                ll, acc, loss_sum, t_loss, t_acc, em = \
+                    self._unpack_host_metrics(packed[j], lanes)
                 self.history.append(
                     round=t,
-                    test_acc=float(acc[j]),
-                    test_loss=float(loss_sum[j]),  # P1 summed-loss flavour
-                    train_loss=float(t_loss[j].mean()),
-                    train_acc=float(t_acc[j].mean()),
-                    local_loss=float(lls[j]),
+                    test_acc=acc,
+                    test_loss=loss_sum,  # P1 summed-loss flavour
+                    train_loss=t_loss,
+                    train_acc=t_acc,
+                    local_loss=ll,
                 )
                 if self._holdout:
-                    em_j = {k_: v[j] for k_, v in ems.items()}
                     if not compact:
-                        em_j = {k_: v[sels[j]] for k_, v in em_j.items()}
-                    self._append_client_rows(t, em_j, sels[j])
+                        em = {k_: v[sels[j]] for k_, v in em.items()}
+                    self._append_client_rows(t, em, sels[j])
                 self.round += 1
             done += k
         self.total_time = time.time() - t0
@@ -558,7 +579,7 @@ class FederatedTrainer:
             step_fn = self._compact_fn if compact else self._round_fn
             gate = jnp.asarray(sel) if compact else jnp.asarray(mask)
             (self.theta, self.params, self.momentum, new_duals, new_c,
-             local_loss, evalm, trainm, em) = self.timers.measure(
+             packed) = self.timers.measure(
                 "round_step", step_fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
                 gate, idx, bweight,
@@ -569,22 +590,39 @@ class FederatedTrainer:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
+            lanes = len(sel) if compact else self.num_workers
+            ll, acc, loss_sum, t_loss, t_acc, em = self._unpack_host_metrics(
+                np.asarray(packed), lanes)  # ONE device→host fetch per round
             self.history.append(
                 round=t,
-                test_acc=float(evalm["acc"]),
-                test_loss=float(evalm["loss_sum"]),   # P1 summed-loss flavour
-                train_loss=float(np.mean(np.asarray(trainm["loss_mean"]))),
-                train_acc=float(np.mean(np.asarray(trainm["acc"]))),
-                local_loss=float(local_loss),
+                test_acc=acc,
+                test_loss=loss_sum,   # P1 summed-loss flavour
+                train_loss=t_loss,
+                train_acc=t_acc,
+                local_loss=ll,
             )
             if self._holdout:
-                em_np = {k_: np.asarray(v) for k_, v in em.items()}
                 if not compact:
-                    em_np = {k_: v[sel] for k_, v in em_np.items()}
-                self._append_client_rows(t, em_np, sel)
+                    em = {k_: v[sel] for k_, v in em.items()}
+                self._append_client_rows(t, em, sel)
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
+
+    def _unpack_host_metrics(self, vec: np.ndarray, lanes: int):
+        """Inverse of the round step's ``pack_host_metrics``: one fetched
+        f32 vector → (local_loss, test_acc, test_loss_sum, train_loss,
+        train_acc, em dict of [lanes, E] arrays or {})."""
+        ll, acc, loss_sum, t_loss, t_acc = (float(v) for v in vec[:5])
+        em: dict[str, np.ndarray] = {}
+        if self._holdout:
+            e = self.cfg.federated.local_ep
+            n = lanes * e
+            body = vec[5:]
+            for i, k in enumerate(("train_loss", "train_acc", "val_acc",
+                                   "val_loss")):
+                em[k] = body[i * n:(i + 1) * n].reshape(lanes, e)
+        return ll, acc, loss_sum, t_loss, t_acc, em
 
     def _append_client_rows(self, t: int, em: dict, workers) -> None:
         """Per-epoch per-client history rows (P1 Client.history schema,
@@ -592,7 +630,7 @@ class FederatedTrainer:
         val_acc, val_loss} with val_loss in P1's summed-batch-loss
         flavour), one row per (sampled client, epoch)."""
         tl, ta = em["train_loss"], em["train_acc"]
-        va, vl = em["val_acc"], em["val_loss_sum"]
+        va, vl = em["val_acc"], em["val_loss"]
         for j, wid in enumerate(workers):
             for e in range(tl.shape[1]):
                 self.client_history.append(

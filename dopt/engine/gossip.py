@@ -350,6 +350,24 @@ class GossipTrainer:
             p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
             return p_t, m_t, losses, accs, {}
 
+        def pack_host_metrics(tl, ta, evalm, em):
+            """Everything the host reads per round, as ONE flat f32
+            vector — on this hardware every device→host fetch pays a
+            fixed ~100 ms tunnel round-trip, so the round's metrics
+            (train loss/acc, fleet-mean eval, and the per-epoch
+            client-history block under the holdout) travel in a single
+            transfer.  Layout (mirrored by ``_unpack_host_metrics``):
+            [tl, ta, mean(acc), mean(loss_mean)] + 4×[W·E] em blocks."""
+            parts = [tl[None], ta[None],
+                     jnp.mean(evalm["acc"])[None],
+                     jnp.mean(evalm["loss_mean"])[None]]
+            if use_holdout:
+                parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
+                          em["val_acc"].ravel(),
+                          em["val_loss_mean"].ravel()]
+            return jnp.concatenate(
+                [p.astype(jnp.float32) for p in parts])
+
         def round_fn(params, mom, x_hat, w_matrix, alive, t, idx, bweight,
                      train_x, train_y, ex, ey, ew, vidx, vw, do_eval):
             if is_choco:
@@ -369,7 +387,7 @@ class GossipTrainer:
                 p_t = where_mask(alive, p_t, params)
                 m_t = where_mask(alive, m_t, mom)
             tl, ta = train_metrics(losses, accs, alive)
-            return p_t, m_t, x_hat, tl, ta, evalm, em
+            return p_t, m_t, x_hat, pack_host_metrics(tl, ta, evalm, em)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
@@ -412,13 +430,13 @@ class GossipTrainer:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                return (p_t, m_t, xh), (tl, ta, evalm, em)
+                return (p_t, m_t, xh), pack_host_metrics(tl, ta, evalm, em)
 
-            (params, mom, x_hat), (tl, ta, evalms, ems) = jax.lax.scan(
+            (params, mom, x_hat), packed = jax.lax.scan(
                 body, (params, mom, x_hat), (w_mats, alive, ts, idx, bw,
                                              is_eval)
             )
-            return params, mom, x_hat, tl, ta, evalms, ems
+            return params, mom, x_hat, packed
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
 
@@ -450,44 +468,57 @@ class GossipTrainer:
             is_eval = np.asarray(
                 [(t % self.eval_every) == 0 for t in ts], dtype=bool
             )
-            (self.params, self.momentum, self.x_hat, tl, ta, evalms,
-             ems) = self.timers.measure(
+            (self.params, self.momentum, self.x_hat,
+             packed) = self.timers.measure(
                 "round_step", self._block_fn,
                 self.params, self.momentum, self.x_hat, w_mats, alive,
                 jnp.asarray(ts, jnp.int32), idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
                 *self._eval, *self._val,
             )
-            tl, ta = np.asarray(tl), np.asarray(ta)
-            acc = np.asarray(evalms["acc"])
-            loss_mean = np.asarray(evalms["loss_mean"])
-            ems = {k_: np.asarray(v) for k_, v in ems.items()}
+            packed = np.asarray(packed)  # ONE device→host fetch per block
             for j, t in enumerate(ts):
+                tl, ta, acc, lm, em = self._unpack_host_metrics(packed[j])
                 row = {
                     "round": t,
-                    "avg_train_loss": float(tl[j]),
-                    "avg_train_acc": float(ta[j]),
+                    "avg_train_loss": tl,
+                    "avg_train_acc": ta,
                 }
                 if is_eval[j]:
-                    row["avg_test_acc"] = float(acc[j].mean())
-                    row["avg_test_loss"] = float(loss_mean[j].mean())
+                    row["avg_test_acc"] = acc
+                    row["avg_test_loss"] = lm
                 self.history.append(**row)
                 if self._holdout:
-                    self._append_client_rows(
-                        t, {k_: v[j] for k_, v in ems.items()})
+                    self._append_client_rows(t, em)
                 self.round += 1
             done += k
         self.total_time = time.time() - t0
         return self.history
 
     # ------------------------------------------------------------------
+    def _unpack_host_metrics(self, vec: np.ndarray):
+        """Inverse of the round step's ``pack_host_metrics``: one fetched
+        f32 vector → (train_loss, train_acc, mean_test_acc,
+        mean_test_loss, em dict of [W, E] arrays or {})."""
+        tl, ta, acc, lm = (float(vec[0]), float(vec[1]), float(vec[2]),
+                           float(vec[3]))
+        em: dict[str, np.ndarray] = {}
+        if self._holdout:
+            w, e = self.num_workers, self.cfg.gossip.local_ep
+            n = w * e
+            body = vec[4:]
+            for i, k in enumerate(("train_loss", "train_acc", "val_acc",
+                                   "val_loss")):
+                em[k] = body[i * n:(i + 1) * n].reshape(w, e)
+        return tl, ta, acc, lm, em
+
     def _append_client_rows(self, t: int, em: dict) -> None:
         """Per-epoch per-worker history rows (P2 Client.history schema,
         clients.py:52-57: {iter, train_loss, train_acc, val_acc,
         val_loss} with val_loss in P2's mean-per-batch flavour), one row
         per (worker, epoch)."""
         tl, ta = em["train_loss"], em["train_acc"]
-        va, vl = em["val_acc"], em["val_loss_mean"]
+        va, vl = em["val_acc"], em["val_loss"]
         for i in range(self.num_workers):
             for e in range(tl.shape[1]):
                 self.client_history.append(
@@ -557,26 +588,27 @@ class GossipTrainer:
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
-            (self.params, self.momentum, self.x_hat, train_loss, train_acc,
-             evalm, em) = self.timers.measure(
+            (self.params, self.momentum, self.x_hat,
+             packed) = self.timers.measure(
                 "round_step", self._round_fn,
                 self.params, self.momentum, self.x_hat, w_t, alive,
                 jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
                 do_eval,
             )
+            tl, ta, acc, lm, em = self._unpack_host_metrics(
+                np.asarray(packed))  # ONE device→host fetch per round
             row = {
                 "round": t,
-                "avg_train_loss": float(train_loss),
-                "avg_train_acc": float(train_acc),
+                "avg_train_loss": tl,
+                "avg_train_acc": ta,
             }
             if do_eval:
-                row["avg_test_acc"] = float(np.mean(np.asarray(evalm["acc"])))
-                row["avg_test_loss"] = float(np.mean(np.asarray(evalm["loss_mean"])))
+                row["avg_test_acc"] = acc
+                row["avg_test_loss"] = lm
             self.history.append(**row)
             if self._holdout:
-                self._append_client_rows(
-                    t, {k_: np.asarray(v) for k_, v in em.items()})
+                self._append_client_rows(t, em)
             self.round += 1
         self.total_time = time.time() - t0
         return self.history
