@@ -132,12 +132,14 @@ class GossipConfig:
     comm_impl: str = "auto"     # consensus collective: auto | dense | shift
     # 'dense'  — all_gather + contraction with the [n, n] mixing matrix
     #            (right for complete/random/arbitrary graphs).
-    # 'shift'  — lax.ppermute per circulant diagonal of W over ICI:
-    #            O(k·|θ|) bytes/round instead of O(n·|θ|) (ring: k=2).
-    #            Requires workers == mesh devices on a flat 1-D mesh and
-    #            a topology whose schedule decomposes into shifts.
-    # 'auto'   — shift when those conditions hold and the shift count is
-    #            small (≤ max(2, n/2)); dense otherwise.
+    # 'shift'  — lax.ppermute over ICI: the [n, n] circulant decomposes
+    #            into device-level ring rotations + a static lane slice
+    #            (workers fold onto devices in n/D lanes), moving
+    #            O(rotations·lanes·|θ|) bytes/round instead of the dense
+    #            O(n·|θ|).  Requires a flat 1-D mesh and a topology
+    #            whose schedule decomposes into circulant shifts.
+    # 'auto'   — shift when those conditions hold and the ppermute bytes
+    #            beat the all_gather with a 2× margin; dense otherwise.
     # Determinism note: runs are bit-reproducible for a fixed config AND
     # mesh, but 'auto' picks per mesh shape, and the two paths can
     # differ in the last float bit for non-dyadic weights (gemm FMA vs

@@ -235,24 +235,34 @@ class GossipTrainer:
         self._shift_ids: tuple[int, ...] | None = None
         if g.comm_impl != "dense" and self.mixing is not None and (do_mix or is_choco):
             flat_1d = len(mesh.axis_names) == 1
-            one_worker_per_device = mesh.size == w
             extra = (0,) if has_dropout else ()
-            # auto: only take the shift path when it beats all_gather
-            # comfortably; explicit 'shift' honors any decomposable set.
-            # Floor of 3 so self-looped rings (metropolis: shifts
-            # {0, 1, n-1}) stay on the ppermute path at any n.
-            limit = (None if g.comm_impl == "shift"
-                     else max(3, w // 2) + (1 if has_dropout else 0))
-            ids = (schedule_shift_decomposition(self.mixing, max_shifts=limit,
+            ids = (schedule_shift_decomposition(self.mixing, max_shifts=None,
                                                 extra_shifts=extra)
-                   if (flat_1d and one_worker_per_device) else None)
+                   if flat_1d else None)
+            if ids is not None and g.comm_impl == "auto":
+                # Take the ppermute path only when its ICI bytes beat the
+                # all_gather with a 2× margin: the folded decomposition
+                # ships only the lanes its shifts consume
+                # (shift_comm_lanes) vs the dense path's (n − L) remote
+                # lanes per device.  Ring/dynamic at any fold factor
+                # qualifies; complete graphs never do.
+                from dopt.parallel.collectives import shift_comm_lanes
+
+                lanes = w // mesh.size
+                shipped = shift_comm_lanes(ids, lanes, mesh.size)
+                # Floor of 3 shipped lanes: tiny rings (n ≤ 4, where the
+                # 2× margin can't hold numerically) stay on the ppermute
+                # path — point-to-point neighbor traffic still beats a
+                # gather at equal bytes, and routing must be stable in n.
+                if shipped > 3 and 2 * shipped > max(w - lanes, 1):
+                    ids = None
             if ids is not None:
                 self._shift_ids = ids
             elif g.comm_impl == "shift":
                 raise ValueError(
-                    "comm_impl='shift' requires workers == mesh devices on a "
-                    f"flat 1-D mesh (workers={w}, mesh={mesh.shape}) and a "
-                    "mixing schedule that decomposes into circulant shifts "
+                    "comm_impl='shift' requires a flat 1-D worker mesh "
+                    f"(workers={w}, mesh={mesh.shape}) and a mixing "
+                    "schedule that decomposes into circulant shifts "
                     f"(topology={g.topology!r})")
         elif g.comm_impl == "shift":
             raise ValueError(

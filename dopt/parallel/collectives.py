@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dopt.parallel.mesh import WORKER_AXIS
@@ -96,37 +97,123 @@ def _mix_dense_compressed(stacked, w, mesh: Mesh, comm_dtype):
     return jax.tree.map(mix_leaf, stacked)
 
 
+def _shift_plan(shift_ids, lanes: int, num_devices: int):
+    """Static routing plan for the folded shift path.
+
+    Returns ``(plan, ship)`` where ``plan[k] = (q0, q1, r)`` decomposes
+    global shift ``shift_ids[k]`` into its device rotations and lane
+    offset, and ``ship[q]`` is the sorted list of source lanes that must
+    actually travel for nonzero rotation q — the union over consuming
+    shifts, NOT the whole lane block.  A straddling ring shift (r ≠ 0)
+    needs only ``lanes − r`` lanes from rotation q and ``r`` from q+1,
+    so e.g. the 32-worker ring on 8 devices ships 2 lane-shards per
+    device per round instead of 8 full blocks.
+
+    Contiguity invariant used by ``mix_shifts``: every consumer needs a
+    contiguous lane range [a, b), and since ship[q] ⊇ [a, b) is a sorted
+    list of distinct lanes, that range occupies contiguous positions in
+    the shipped block.
+    """
+    plan: list[tuple[int, int, int]] = []
+    need: dict[int, set[int]] = {}
+    for s in shift_ids:
+        q, r = divmod(int(s), lanes)
+        q0, q1 = q % num_devices, (q + 1) % num_devices
+        plan.append((q0, q1, r))
+        if r == 0:
+            if q0 != 0:
+                need.setdefault(q0, set()).update(range(lanes))
+        else:
+            if q0 != 0:
+                need.setdefault(q0, set()).update(range(r, lanes))
+            if q1 != 0:
+                need.setdefault(q1, set()).update(range(r))
+    ship = {q: sorted(v) for q, v in need.items()}
+    return plan, ship
+
+
+def device_rotations(shift_ids, lanes: int, num_devices: int) -> tuple[int, ...]:
+    """The nonzero device-level ring rotations (one ``lax.ppermute``
+    each) the folded shift path needs for a global circulant shift set:
+    shift s = q·lanes + r touches rotation q (and q+1 when r ≠ 0)."""
+    _, ship = _shift_plan(shift_ids, lanes, num_devices)
+    return tuple(sorted(ship))
+
+
+def shift_comm_lanes(shift_ids, lanes: int, num_devices: int) -> int:
+    """Total worker-lane shards each device ships per ``mix_shifts``
+    call — the shift path's ICI byte cost in units of |θ|-sized lanes,
+    which the engine's 'auto' heuristic compares against the dense
+    all_gather's (n − lanes) remote lanes per device."""
+    _, ship = _shift_plan(shift_ids, lanes, num_devices)
+    return sum(len(v) for v in ship.values())
+
+
 def mix_shifts(stacked, shift_ids, coeff_table, mesh: Mesh, comm_dtype=None):
     """Explicit ICI path: x_i ← Σ_s coeff_s[i] · x_{(i+s) mod n}.
 
     ``shift_ids`` is the STATIC tuple of circulant shifts (compiled into
-    the program — one ``lax.ppermute`` ring rotation each, the canonical
-    ICI-friendly pattern); ``coeff_table`` is the per-round [k, n]
-    float32 coefficient DATA (``dopt.topology.coeffs_for_matrix``), so
+    the program); ``coeff_table`` is the per-round [k, n] float32
+    coefficient DATA (``dopt.topology.coeffs_for_matrix``), so
     time-varying schedules and dropout-repaired matrices reuse one
-    compiled step.  Requires one worker per device (workers ==
-    mesh.size); the engine falls back to ``mix_dense`` otherwise.
+    compiled step.
+
+    Workers fold onto devices in L = n / mesh.size contiguous lanes
+    (worker i = device i//L, lane i%L — the ``shard_worker_tree``
+    layout).  The [n, n] circulant then decomposes into DEVICE-level
+    ring rotations plus a static lane slice: global shift s = q·L + r
+    needs lanes r..L-1 from device d+q and, when r ≠ 0, lanes 0..r-1
+    from device d+q+1.  Each nonzero rotation is ONE ``lax.ppermute``
+    carrying only the union of lanes its consumers need (``_shift_plan``)
+    — a folded ring ships 2 single-lane shards per device per round
+    (e.g. 32 workers on a v5e-8, SURVEY §7's "cores=8, workers_per_core=4"
+    plan) instead of the dense path's (n − L)-lane all_gather.  L = 1
+    degenerates to the classic one-rotation-per-shift ring schedule.
     """
-    n = mesh.size
+    D = mesh.size
     shift_ids = tuple(int(s) for s in shift_ids)
     coeff_table = jnp.asarray(coeff_table, dtype=jnp.float32)
+    n = coeff_table.shape[1]
+    if n % D:
+        raise ValueError(f"{n} workers do not fold onto {D} devices evenly")
+    L = n // D
+    plan, ship = _shift_plan(shift_ids, L, D)
+    # Shipped-block bookkeeping: lane a of rotation q sits at position
+    # pos[q][a] in that rotation's payload; contiguous source ranges
+    # stay contiguous (see _shift_plan docstring).
+    pos = {q: {lane: i for i, lane in enumerate(lanes_q)}
+           for q, lanes_q in ship.items()}
 
     def per_device(coeffs, x):
-        # x: [1, ...] local worker shard; coeffs: [k, 1] this worker's weights
-        # comm_dtype narrows the shard only for the ppermute hops (the
-        # bytes on the wire); the shift-0 self term never crosses a wire
-        # and stays exact, and accumulation stays at the leaf dtype.
+        # x: [L, ...] local lane block; coeffs: [k, L] this block's weights.
+        # comm_dtype narrows the payload only for the ppermute hops (the
+        # bytes on the wire); lane values that never cross a wire (the
+        # q == 0 contributions, incl. the shift-0 self term) stay exact,
+        # and accumulation stays at the leaf dtype.
         xc = x.astype(comm_dtype) if comm_dtype is not None else x
+        blocks = {}
+        for q, lanes_q in ship.items():
+            payload = xc if len(lanes_q) == L else xc[np.asarray(lanes_q)]
+            perm = [((d + q) % D, d) for d in range(D)]
+            blocks[q] = jax.lax.ppermute(payload, WORKER_AXIS,
+                                         perm).astype(x.dtype)
+
+        def part(q, a, b):
+            """Lanes [a, b) sourced from rotation q (0 = local/exact)."""
+            if q == 0:
+                return x[a:b]
+            p = pos[q][a]
+            return blocks[q][p:p + (b - a)]
+
         acc = jnp.zeros_like(x)
-        for k, s in enumerate(shift_ids):
-            if s == 0:
-                contrib = x
+        for k, (q0, q1, r) in enumerate(plan):
+            if r == 0:
+                contrib = part(q0, 0, L)
             else:
-                # worker i needs x_{(i+s) mod n}: the shard travels from
-                # device (d+s) mod n to device d.
-                perm = [((d + s) % n, d) for d in range(n)]
-                contrib = jax.lax.ppermute(xc, WORKER_AXIS, perm)
-            acc = acc + coeffs[k].astype(x.dtype) * contrib.astype(x.dtype)
+                contrib = jnp.concatenate([part(q0, r, L), part(q1, 0, r)],
+                                          axis=0)
+            c = coeffs[k].reshape((L,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+            acc = acc + c * contrib
         return acc
 
     coeff_specs = P(None, WORKER_AXIS)  # [k, n] -> coeffs sharded on worker axis

@@ -274,13 +274,40 @@ class MixingMatrices:
         return self.is_row_stochastic(tol) and all(
             np.all(np.abs(m.sum(0) - 1) < tol) for m in self.matrices)
 
-    def spectral_gap(self) -> float:
-        """1 - |λ₂| of the (round-averaged) mixing matrix: the standard
-        consensus-rate diagnostic."""
-        m = np.mean(self.stacked(), axis=0)
+    @staticmethod
+    def _gap_of(m: np.ndarray) -> float:
         ev = np.sort(np.abs(np.linalg.eigvals(m)))[::-1]
         lam2 = ev[1] if len(ev) > 1 else 0.0
         return float(1.0 - lam2)
+
+    def spectral_gap(self, kind: str = "product") -> float:
+        """Consensus-rate diagnostic: 1 - |λ₂|.
+
+        kind='product' (default): gap of the per-period product
+        ``∏_{t=T-1..0} W_t`` — for a time-varying schedule the consensus
+        error after one period contracts by that product's λ₂, so this
+        is the quantity that actually governs convergence (B-connected
+        gossip analysis).  For a static schedule (len 1) it degenerates
+        to the single-matrix gap.
+
+        kind='mean': gap of the round-averaged matrix — the classical
+        static diagnostic.  It can over- OR under-state the rate of a
+        dynamic schedule (averaging single-edge graphs looks far better
+        connected than any round actually is), so use it only for
+        static topologies or coarse comparisons.
+
+        Note the per-period product gap is a per-PERIOD contraction; to
+        compare schedules of different lengths on a per-round basis use
+        ``1 - (1 - gap)**(1/T)``.
+        """
+        if kind == "mean":
+            return self._gap_of(np.mean(self.stacked(), axis=0))
+        if kind != "product":
+            raise ValueError(f"kind must be 'product' or 'mean', got {kind!r}")
+        prod = np.eye(self.n)
+        for m in self.matrices:
+            prod = m @ prod
+        return self._gap_of(prod)
 
 
 def build_mixing_matrices(

@@ -9,11 +9,20 @@ orbax for the array pytrees plus a JSON sidecar for scalars/history.
 
 Layout:  <dir>/state/   orbax pytree checkpoint
          <dir>/meta.json  {round, name, history rows}
+
+Saves are atomic: the new checkpoint is fully materialised in a
+``<dir>.tmp`` sibling, the previous checkpoint (if any) is parked at
+``<dir>.old``, and only then is the sibling renamed into place.  A crash
+at any point leaves at least one complete checkpoint loadable —
+``load_checkpoint`` transparently falls back to ``<dir>.old`` when the
+primary directory is missing or incomplete.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import shutil
 from pathlib import Path
 from typing import Any
 
@@ -32,29 +41,74 @@ def _to_numpy(tree):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
-                    meta: dict[str, Any]) -> Path:
-    """Save an arrays pytree (orbax) + JSON metadata."""
-    path = Path(path).absolute()
-    path.mkdir(parents=True, exist_ok=True)
-    arrays = {k: _to_numpy(v) for k, v in arrays.items() if v is not None}
+def _write_state(dest: Path, arrays: dict[str, Any]) -> None:
+    """Materialise the arrays pytree under ``dest`` (orbax or npz)."""
     if HAVE_ORBAX:
         ckpt = ocp.PyTreeCheckpointer()
-        state_dir = path / "state"
-        if state_dir.exists():
-            import shutil
-
-            shutil.rmtree(state_dir)
-        ckpt.save(state_dir, arrays)
+        ckpt.save(dest / "state", arrays)
     else:  # numpy fallback keeps the feature alive without orbax
-        np.savez(path / "state.npz", **_flatten_for_npz(arrays))
-    (path / "meta.json").write_text(json.dumps(meta, indent=2))
+        np.savez(dest / "state.npz", **_flatten_for_npz(arrays))
+
+
+def _write_meta(dest: Path, meta: dict[str, Any]) -> None:
+    (dest / "meta.json").write_text(json.dumps(meta, indent=2))
+
+
+def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
+                    meta: dict[str, Any]) -> Path:
+    """Save an arrays pytree (orbax) + JSON metadata, atomically.
+
+    The previous checkpoint at ``path`` is never modified in place: the
+    new one is built in ``<path>.tmp`` and swapped in via two renames
+    (old → ``<path>.old``, tmp → ``path``).  A crash anywhere in between
+    leaves either ``path`` or ``<path>.old`` as a complete checkpoint.
+    """
+    path = Path(path).absolute()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = {k: _to_numpy(v) for k, v in arrays.items() if v is not None}
+
+    tmp = path.with_name(path.name + ".tmp")
+    old = path.with_name(path.name + ".old")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    _write_state(tmp, arrays)
+    _write_meta(tmp, meta)
+
+    # Swap: park the previous checkpoint, promote the new one, then drop
+    # the parked copy.  os.replace cannot overwrite a non-empty dir, so
+    # the parked copy doubles as the crash-window fallback.  When the
+    # primary is MISSING (we are saving after a crash that left only
+    # ``<path>.old``), the parked copy is the sole good checkpoint — it
+    # must survive until the promotion rename lands, so the cleanup
+    # happens strictly after ``os.replace(tmp, path)`` in every case.
+    if path.exists():
+        if old.exists():
+            shutil.rmtree(old)   # safe: primary still intact
+        os.replace(path, old)
+    os.replace(tmp, path)
+    if old.exists():
+        shutil.rmtree(old)
     return path
 
 
+def _is_complete(path: Path) -> bool:
+    if not (path / "meta.json").exists():
+        return False
+    return (path / "state").exists() or (path / "state.npz").exists()
+
+
 def load_checkpoint(path: str | Path) -> tuple[dict[str, Any], dict[str, Any]]:
-    """Returns (arrays, meta)."""
+    """Returns (arrays, meta).
+
+    Falls back to ``<path>.old`` when ``path`` is absent or incomplete
+    (the save crashed between the two promotion renames).
+    """
     path = Path(path).absolute()
+    if not _is_complete(path):
+        old = path.with_name(path.name + ".old")
+        if _is_complete(old):
+            path = old
     meta = json.loads((path / "meta.json").read_text())
     if HAVE_ORBAX and (path / "state").exists():
         ckpt = ocp.PyTreeCheckpointer()

@@ -79,3 +79,54 @@ def trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------
+# FLOP accounting (MFU meters for the benchmark harnesses)
+# ---------------------------------------------------------------------
+
+# Public per-chip peak throughput (bf16 matmul peak).  MFU for f32 runs
+# is reported against the same bf16 peak so modes stay comparable — the
+# hardware ceiling is the MXU's.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e, bf16
+    "TPU v5": 459e12,        # v5p, bf16
+    "TPU v4": 275e12,
+}
+
+
+def device_peak_flops() -> tuple[str, float | None]:
+    """(device_kind, bf16 peak FLOP/s or None when unknown, e.g. CPU)."""
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return kind, v
+    return kind, None
+
+
+def fwd_flops_per_sample(fn, params, input_shape, *, batch: int = 8,
+                         dtype=None) -> float:
+    """Forward-pass FLOPs per sample from XLA's compiled cost analysis.
+
+    ``fn(params, x)`` is the forward callable (e.g. ``lambda p, x:
+    model.apply({'params': p}, x)``).  Generic across the zoo — no
+    per-model analytic tables — and counts what XLA actually lowers
+    (convs at 2·MACs, elementwise, norms), so it is the right numerator
+    for MFU accounting.  Uses a small batch and divides, which washes
+    out fixed per-call ops."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((batch, *input_shape), dtype or jnp.float32)
+    compiled = jax.jit(fn).lower(params, x).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    return float(ca["flops"]) / batch
+
+
+def train_flops_per_sample(fn, params, input_shape, *, batch: int = 8,
+                           dtype=None) -> float:
+    """Training FLOPs per sample ≈ 3 × forward (fwd + ~2× in backward)
+    — the standard accounting used by the MFU literature."""
+    return 3.0 * fwd_flops_per_sample(fn, params, input_shape, batch=batch,
+                                      dtype=dtype)

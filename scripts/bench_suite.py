@@ -179,11 +179,20 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
     )
     is_gossip = cfg.gossip is not None
     g = cfg.gossip if is_gossip else cfg.federated
-    rounds = 3 if quick else (5 if cfg.model.model == "resnet18" else 10)
+    # Tiny models (baseline4's 248-param logistic) get a long fused
+    # window: per-scan-iteration overhead is the whole round there, so
+    # a short window would time the dispatch floor's variance, not the
+    # workload.
+    tiny = cfg.model.model == "logistic"
+    rounds = 3 if quick else (5 if cfg.model.model == "resnet18"
+                              else 200 if tiny else 10)
 
     trainer = (GossipTrainer if is_gossip else FederatedTrainer)(cfg)
     run_kwargs = {"block": rounds}
     trainer.run(rounds=rounds, **run_kwargs)           # compile + warmup
+    from dopt.utils.profiling import PhaseTimers
+
+    trainer.timers = PhaseTimers()   # phase breakdown = measured window only
     t0 = time.perf_counter()
     trainer.run(rounds=rounds, **run_kwargs)
     elapsed = time.perf_counter() - t0
@@ -196,6 +205,23 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
     else:
         workers_per_round = max(int(cfg.federated.frac * w), 1)
     samples_per_round = workers_per_round * g.local_ep * part_len
+    sps = rps * samples_per_round
+
+    # MFU accounting for EVERY config (same meter as bench.py's
+    # headline): training FLOPs/sample from XLA's compiled cost
+    # analysis of the zoo model — generic, no per-model tables.
+    import jax
+
+    from dopt.utils.profiling import device_peak_flops, train_flops_per_sample
+
+    p0 = jax.tree.map(lambda x: np.asarray(x[0]),
+                      jax.device_get(trainer.params))
+    tfps = train_flops_per_sample(
+        lambda p, x: trainer.model.apply({"params": p}, x), p0,
+        cfg.model.input_shape)
+    flops_per_round = tfps * samples_per_round
+    kind, peak = device_peak_flops()
+
     out = {
         "preset": name,
         "model": cfg.model.model,
@@ -210,9 +236,19 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         # models — baseline4's 248-param logistic round is pure host
         # overhead without it)
         "tpu_rounds_per_sec": round(rps, 4),
-        "tpu_samples_per_sec": round(rps * samples_per_round, 1),
+        "tpu_samples_per_sec": round(sps, 1),
+        "train_flops_per_sample": round(tfps),
+        "flops_per_round": round(flops_per_round),
+        "model_tflops_per_sec": round(sps * tfps / 1e12, 3),
+        "device_kind": kind,
         "compute_dtype": "bfloat16",
+        # Measured-window phase attribution (PhaseTimers): round_step is
+        # the blocking device time of the fused scan dispatch,
+        # host_batch_plan the host-side planning.
+        "phases": trainer.timers.summary(),
     }
+    if peak:
+        out["mfu_vs_bf16_peak"] = round(sps * tfps / peak, 4)
     if not skip_oracle:
         # resnet18: a full 800-step round on 1 CPU core takes ~minutes;
         # 24 timed steady-state steps bound the per-step time well (the
@@ -231,6 +267,33 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         out["oracle_steps_timed"] = steps_timed
         out["oracle_steps_per_worker_round"] = steps_total
         out["speedup_vs_sequential_torch_cpu"] = round(oracle_s * rps, 1)
+        # Is the ≥50× north-star bar a COMPUTE comparison for this
+        # config?  Decided from utilisation, independently of whether
+        # the speedup happened to reach 50: when the round runs below
+        # 1% of the chip's peak (mfu), >99% of its wall-clock is
+        # dispatch/latency overhead — the measured 1/rps is then the
+        # framework's per-round latency FLOOR, not a compute time, and
+        # any speedup ratio against it grades latency, not the compute
+        # path.  At that floor, hitting 50× would need
+        #   flops_per_round ≥ 50 × (1/rps) × oracle_flops_per_sec,
+        # which is reported so the gap is quantified, not hand-waved.
+        oracle_fps = flops_per_round / oracle_s
+        out["oracle_flops_per_sec"] = round(oracle_fps)
+        if peak:
+            latency_bound = (sps * tfps / peak) < 0.01
+            out["speedup_is_compute_comparison"] = not latency_bound
+            if latency_bound:
+                min_flops_50x = 50.0 * (1.0 / rps) * oracle_fps
+                out["min_flops_per_round_for_50x_at_this_floor"] = round(
+                    min_flops_50x)
+                out["note"] = (
+                    "TPU round is latency-floor-bound, not compute-bound "
+                    f"(mfu {sps * tfps / peak:.2e} < 1% of bf16 peak): at "
+                    f"the {1e3 / rps:.2f} ms/round floor the "
+                    "50x-vs-sequential-CPU bar needs >= "
+                    f"{min_flops_50x:.3g} FLOP/round, this config has "
+                    f"{flops_per_round:.3g} — the speedup column here "
+                    "measures dispatch latency, not the compute path.")
     return out
 
 

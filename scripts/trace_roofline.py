@@ -1,0 +1,153 @@
+"""Profiler-trace-backed roofline evidence for the benchmark configs.
+
+Captures a real XLA profiler trace (``dopt.utils.profiling.trace``) of a
+steady-state fused round block, then reduces the xplane to a committed
+JSON summary: per-op-category self time, the top ops, and the
+device/host split.  This is the evidence layer behind the MFU numbers
+in ``results/bench_suite.json`` and ``BENCH_r*.json`` — the prose
+roofline claims ("activation-bandwidth-bound", "conv1 has 1 input
+channel") become checkable op-level timings.
+
+Targets: ``--preset baseline5`` (32-worker ResNet-18 gossip, the north
+star) and ``--preset headline`` (bench.py's 6-worker Model1 workload).
+
+Writes results/trace_<name>.json (the raw xplane stays out of git — it
+is hundreds of KB of protobuf; the summary carries the numbers).
+
+Usage: python scripts/trace_roofline.py --preset baseline5 [--rounds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_trainer(preset: str):
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    if preset == "headline":
+        import bench
+
+        cfg = bench._config(fast=True, train_size=60_000, test_size=10_000)
+    else:
+        from dopt.presets import get_preset
+
+        cfg = get_preset(preset)
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, compute_dtype="bfloat16"),
+            data=dataclasses.replace(cfg.data, plan_impl="native"),
+        )
+    is_gossip = cfg.gossip is not None
+    trainer = (GossipTrainer if is_gossip else FederatedTrainer)(
+        cfg, eval_every=10_000)   # no eval inside the traced window
+    return cfg, trainer
+
+
+def summarize_xplane(trace_dir: str) -> dict:
+    """Reduce the captured xplane to category/op-level self times."""
+    from xprof.convert import raw_to_tool_data
+
+    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data, _ = raw_to_tool_data.xspace_to_tool_data(paths,
+                                                   "framework_op_stats", {})
+    table = json.loads(data if isinstance(data, str) else data.decode())
+    if isinstance(table, list):
+        table = table[0]
+    cols = [c["id"] for c in table["cols"]]
+    idx = {c: i for i, c in enumerate(cols)}
+
+    def val(row, col):
+        cell = row["c"][idx[col]]
+        return None if cell is None else cell.get("v")
+
+    by_cat: dict[str, float] = {}
+    device_total = host_total = 0.0
+    ops = []
+    for row in table.get("rows", []):
+        side = val(row, "host_or_device")
+        self_us = float(val(row, "total_self_time") or 0.0)
+        cat = val(row, "type") or "?"
+        if side == "Device":
+            device_total += self_us
+            by_cat[cat] = by_cat.get(cat, 0.0) + self_us
+            ops.append({
+                "op_type": cat,
+                "operation": val(row, "operation"),
+                "occurrences": val(row, "occurrences"),
+                "total_self_time_us": round(self_us, 1),
+            })
+        else:
+            host_total += self_us
+    ops.sort(key=lambda o: -o["total_self_time_us"])
+    cat_rows = sorted(by_cat.items(), key=lambda kv: -kv[1])
+    return {
+        "device_self_time_us": round(device_total, 1),
+        "host_self_time_us": round(host_total, 1),
+        "device_categories": [
+            {"op_type": k, "self_time_us": round(v, 1),
+             "pct_of_device": round(100.0 * v / max(device_total, 1e-9), 2)}
+            for k, v in cat_rows
+        ],
+        "top_device_ops": ops[:20],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="baseline5",
+                    help="baseline1..5 or 'headline' (bench.py workload)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="rounds inside the traced fused block")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from dopt.utils.profiling import trace
+
+    cfg, trainer = build_trainer(args.preset)
+    rounds = args.rounds
+    trainer.run(rounds=rounds, block=rounds)          # compile + warmup
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="dopt-trace-") as td:
+        t0 = time.perf_counter()
+        with trace(td):
+            trainer.run(rounds=rounds, block=rounds)
+            jax.block_until_ready(trainer.params)
+        elapsed = time.perf_counter() - t0
+        summary = summarize_xplane(td)
+
+    payload = {
+        "preset": args.preset,
+        "config_name": cfg.name,
+        "model": cfg.model.model,
+        "workers": cfg.data.num_users,
+        "rounds_traced": rounds,
+        "wall_seconds_traced": round(elapsed, 3),
+        "device": str(jax.devices()[0]),
+        **summary,
+    }
+    out = Path(args.out or f"results/trace_{args.preset}.json")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(payload, indent=2) + "\n")
+    top = payload["device_categories"][:5]
+    print(f"{args.preset}: {rounds} rounds traced in {elapsed:.2f}s; "
+          f"device self-time {payload['device_self_time_us']/1e6:.3f}s")
+    for c in top:
+        print(f"  {c['op_type']:<28s} {c['pct_of_device']:6.2f}%")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

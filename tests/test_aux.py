@@ -141,6 +141,27 @@ def test_timers_populated_by_run(devices):
     assert s["host_batch_plan"]["count"] == 2
 
 
+def test_flops_accounting_model1(devices):
+    """XLA cost-analysis FLOPs must agree with Model1's analytic MAC
+    count (bench.py's documented 12,273,152 MACs/sample forward) to
+    within compiler-accounting slack — this pins the generic MFU meter
+    the bench suite uses for every zoo model."""
+    import jax
+    import jax.numpy as jnp
+
+    from dopt.models import build_model
+    from dopt.utils.profiling import (fwd_flops_per_sample,
+                                      train_flops_per_sample)
+
+    model = build_model("model1")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    fn = lambda p, x: model.apply({"params": p}, x)  # noqa: E731
+    f = fwd_flops_per_sample(fn, params, (28, 28, 1))
+    analytic = 2 * 12_273_152
+    assert 0.6 * analytic < f < 1.6 * analytic, f
+    assert train_flops_per_sample(fn, params, (28, 28, 1)) == pytest.approx(3 * f)
+
+
 def test_time_to_target():
     from dopt.utils.metrics import History, time_to_target
 
@@ -170,6 +191,80 @@ def test_client_grid_plot(tmp_path, devices):
     from dopt.utils.metrics import History
     with pytest.raises(ValueError, match="local_holdout"):
         client_grid_plot(History("empty"))
+
+
+def test_checkpoint_atomic_crash_before_promote(tmp_path, monkeypatch):
+    """A save that dies while materialising the new checkpoint (e.g.
+    between the state write and the meta write) must leave the previous
+    checkpoint fully loadable — the old dir is never touched in place."""
+    import dopt.utils.checkpoint as ckpt
+
+    path = tmp_path / "ck"
+    ckpt.save_checkpoint(path, arrays={"w": {"a": np.arange(4.0)}},
+                         meta={"round": 1})
+
+    def boom(dest, meta):
+        raise RuntimeError("simulated crash before meta write")
+
+    monkeypatch.setattr(ckpt, "_write_meta", boom)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        ckpt.save_checkpoint(path, arrays={"w": {"a": np.arange(4.0) * 2}},
+                             meta={"round": 2})
+    monkeypatch.undo()
+    arrays, meta = ckpt.load_checkpoint(path)
+    assert meta["round"] == 1
+    np.testing.assert_array_equal(np.asarray(arrays["w"]["a"]), np.arange(4.0))
+
+
+def test_checkpoint_atomic_crash_between_renames(tmp_path, monkeypatch):
+    """Worst case: the old checkpoint is parked at <path>.old but the
+    promotion rename never happens.  load_checkpoint must fall back."""
+    import os as _os
+
+    import dopt.utils.checkpoint as ckpt
+
+    path = tmp_path / "ck"
+    ckpt.save_checkpoint(path, arrays={"w": {"a": np.arange(3.0)}},
+                         meta={"round": 7})
+
+    real_replace = _os.replace
+    calls = {"n": 0}
+
+    def crashy_replace(src, dst):
+        calls["n"] += 1
+        if calls["n"] == 2:  # first = park old, second = promote tmp
+            raise RuntimeError("simulated crash mid-swap")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(ckpt.os, "replace", crashy_replace)
+    with pytest.raises(RuntimeError, match="mid-swap"):
+        ckpt.save_checkpoint(path, arrays={"w": {"a": np.arange(3.0) * 5}},
+                             meta={"round": 8})
+    monkeypatch.undo()
+    assert not (path / "meta.json").exists()  # primary really is gone
+    arrays, meta = ckpt.load_checkpoint(path)
+    assert meta["round"] == 7
+    np.testing.assert_array_equal(np.asarray(arrays["w"]["a"]), np.arange(3.0))
+
+    # Save-after-crash: with only <path>.old alive, the NEXT save must
+    # keep it loadable through its whole window — in particular .old may
+    # not be deleted before the promotion rename lands.
+    calls["n"] = 10  # disarm
+    monkeypatch.setattr(ckpt.os, "replace", crashy_replace)
+    real_rmtree = ckpt.shutil.rmtree
+
+    def guarded_rmtree(p, *a, **kw):
+        if str(p).endswith(".old") and not (path / "meta.json").exists():
+            raise AssertionError(".old deleted while no primary exists")
+        return real_rmtree(p, *a, **kw)
+
+    monkeypatch.setattr(ckpt.shutil, "rmtree", guarded_rmtree)
+    ckpt.save_checkpoint(path, arrays={"w": {"a": np.arange(3.0) * 9}},
+                         meta={"round": 9})
+    monkeypatch.undo()
+    arrays, meta = ckpt.load_checkpoint(path)
+    assert meta["round"] == 9
+    assert not path.with_name(path.name + ".old").exists()
 
 
 def test_csv_column_order_matches_reference_schema(tmp_path):

@@ -77,6 +77,61 @@ def test_mix_shifts_ring_stochastic(devices):
         np.testing.assert_allclose(np.asarray(out[k]), want[k], rtol=2e-5, atol=1e-6)
 
 
+def test_mix_shifts_folded_lanes_matches_numpy(devices):
+    """Workers folded onto devices (n=32 on 8 devices, 4 lanes each):
+    every circulant shift class — pure device rotation (r=0), pure lane
+    shift (q=0), and straddling both — must reproduce W @ x exactly."""
+    from dopt.parallel.collectives import device_rotations, mix_shifts
+    from dopt.topology import coeffs_for_matrix
+
+    n, d = 32, 8
+    mesh = make_mesh(d)
+    rng = np.random.default_rng(5)
+    # Arbitrary circulant with shifts exercising r=0 (s=8), q=0 (s=1,3),
+    # and straddles (s=5, s=31 wraps device 7 -> 0).
+    shift_ids = (0, 1, 3, 5, 8, 31)
+    w = np.zeros((n, n))
+    for s in shift_ids:
+        w[np.arange(n), (np.arange(n) + s) % n] = rng.random(n)
+    w /= w.sum(axis=1, keepdims=True)
+    coeffs = coeffs_for_matrix(w, shift_ids)
+    tree = shard_worker_tree(_tree(n, seed=9), mesh)
+    out = mix_shifts(tree, shift_ids, coeffs, mesh)
+    want = _np_mix(w.astype(np.float32), tree)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), want[k],
+                                   rtol=2e-5, atol=1e-6)
+    # Rotation dedup: the six global shifts need only three nonzero
+    # device hops (s=0,1,3 are local/+1; s=5 adds +2; s=8 reuses +2;
+    # s=31 adds +7 and wraps back to local).
+    assert device_rotations(shift_ids, n // d, d) == (1, 2, 7)
+    # Lane-sliced shipping: rotation +1 and +2 need their full 4-lane
+    # blocks (s=8 consumes all of +2), but rotation +7 ships only the
+    # single lane s=31 consumes — 9 lane-shards total, not 3×4.
+    from dopt.parallel.collectives import shift_comm_lanes
+
+    assert shift_comm_lanes(shift_ids, n // d, d) == 9
+    # The north-star folded ring ships exactly 2 single-lane shards.
+    assert shift_comm_lanes((0, 1, 31), 4, 8) == 2
+
+
+def test_mix_shifts_folded_comm_compression_bf16(devices):
+    from dopt.parallel.collectives import mix_shifts
+    from dopt.topology import coeffs_for_matrix, build_mixing_matrices
+
+    n, mesh = 16, make_mesh(8)
+    mm = build_mixing_matrices("circle", "metropolis", n)
+    shift_ids = (0, 1, n - 1)
+    coeffs = coeffs_for_matrix(mm.matrices[0], shift_ids)
+    tree = shard_worker_tree(_tree(n, seed=2), mesh)
+    exact = mix_shifts(tree, shift_ids, coeffs, mesh)
+    comp = mix_shifts(tree, shift_ids, coeffs, mesh, comm_dtype=jnp.bfloat16)
+    for k in tree:
+        assert comp[k].dtype == tree[k].dtype
+        np.testing.assert_allclose(np.asarray(comp[k]), np.asarray(exact[k]),
+                                   atol=0.03, rtol=0.03)
+
+
 def test_masked_average_uniform_over_sampled(devices):
     mesh = make_mesh(8)
     tree = shard_worker_tree(_tree(8), mesh)

@@ -496,25 +496,66 @@ def test_comm_impl_shift_choco_and_fedlcon(devices):
 
 
 def test_comm_impl_auto_and_validation(devices):
-    # auto picks shift exactly when workers == devices and the schedule
-    # decomposes into few diagonals.
+    # auto picks shift when the schedule's ppermute bytes beat the
+    # all_gather with a 2x margin.
     assert GossipTrainer(_shift_cfg("auto"))._shift_ids == (1, 7)
-    # complete graph on 8 workers: 7 diagonals > n/2 -> dense.
+    # complete graph on 8 workers: 7 rotations -> dense.
     assert GossipTrainer(_shift_cfg(
         "auto", gossip=dict(topology="complete")))._shift_ids is None
-    # workers fold 2-per-device: no one-worker-per-device mapping.
+    # folded lanes: 16 workers on 8 devices (2 lanes each) still routes
+    # the ring onto ppermutes — the straddling shifts {1, 15} each
+    # consume ONE lane of their neighbor block, so only 2 lane-shards
+    # move per device (shift_comm_lanes) vs 14 for the dense gather.
     assert GossipTrainer(_shift_cfg(
-        "auto", num_users=16))._shift_ids is None
+        "auto", num_users=16))._shift_ids == (1, 15)
+    # folded complete graph: every device rotation needed -> dense.
+    assert GossipTrainer(_shift_cfg(
+        "auto", num_users=16,
+        gossip=dict(topology="complete")))._shift_ids is None
     # explicit shift honors an expensive decomposition (complete = all 7).
     tr = GossipTrainer(_shift_cfg("shift", gossip=dict(topology="complete")))
     assert tr._shift_ids == tuple(range(1, 8))
-    # explicit shift where no mapping exists must fail loudly.
+    # explicit shift on a hybrid (non-flat) mesh must fail loudly.
     with pytest.raises(ValueError, match="comm_impl='shift'"):
-        GossipTrainer(_shift_cfg("shift", num_users=16))
+        GossipTrainer(_shift_cfg("shift", mesh_hosts=2))
     with pytest.raises(ValueError, match="mixing-schedule algorithm"):
         GossipTrainer(_shift_cfg("shift", gossip=dict(algorithm="gossip")))
     with pytest.raises(ValueError, match="comm_impl"):
         GossipTrainer(_shift_cfg("nonsense"))
+
+
+def test_comm_impl_shift_folded_lanes_bitwise_equals_dense(devices):
+    """The north-star shape: 32 workers folded 4-per-device onto the
+    8-device mesh.  The block-circulant decomposition (device ppermutes
+    + lane slice) must be BIT-identical to the dense path through
+    GossipTrainer.run on uniform ring weights."""
+    kw = dict(num_users=32, gossip=dict(local_bs=8, rounds=4))
+    td = GossipTrainer(_shift_cfg("dense", **kw))
+    ts = GossipTrainer(_shift_cfg("shift", **kw))
+    assert ts._shift_ids == (1, 31)
+    assert ts.mesh.size == 8 and ts.num_workers == 32
+    hd, hs = td.run(), ts.run()
+    assert hd.rows == hs.rows
+    for a, b in zip(_leaves(td), _leaves(ts)):
+        assert np.array_equal(a, b)
+    # auto routes this shape onto the shift path (the VERDICT r2 gap:
+    # the flagship collective now reaches the flagship config).
+    assert GossipTrainer(_shift_cfg("auto", **kw))._shift_ids == (1, 31)
+
+
+def test_comm_impl_shift_folded_dynamic_dropout(devices):
+    """Folded lanes + time-varying single-edge graphs + dropout repair:
+    per-round coefficient tables must stay inside the compiled shift set
+    and match dense bit-for-bit (rows have at most one neighbor term)."""
+    g = dict(topology="dynamic", mode="stochastic", dropout=0.3,
+             local_bs=8, rounds=4)
+    td = GossipTrainer(_shift_cfg("dense", num_users=16, gossip=g))
+    ts = GossipTrainer(_shift_cfg("shift", num_users=16, gossip=g))
+    assert ts._shift_ids == (0, 1, 15)
+    hd, hs = td.run(), ts.run()
+    assert hd.rows == hs.rows
+    for a, b in zip(_leaves(td), _leaves(ts)):
+        assert np.array_equal(a, b)
 
 
 # ---------------------------------------------------------------------

@@ -143,6 +143,52 @@ def test_parity_real_skips_without_data(monkeypatch, capsys):
     assert "skipped: no real data" in capsys.readouterr().out
 
 
+def _load_replay_module():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "replay_reference",
+        Path(__file__).parent.parent / "scripts" / "replay_reference.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_committed_replay_grid_orderings():
+    """The committed synthetic replay grid (results/summary.json) must
+    keep exhibiting the qualitative structure results/README.md claims:
+    centralized ≥ complete > fedlcon > circle, star > circle, and
+    {circle, star} > nocons-noniid (star vs fedlcon deliberately
+    unpinned — see ORDERINGS in scripts/replay_reference.py).  A rerun
+    of scripts/replay_reference.py that flips one fails here."""
+    import json
+    from pathlib import Path
+
+    mod = _load_replay_module()
+    summary = json.loads(
+        (Path(__file__).parent.parent / "results" / "summary.json").read_text())
+    assert mod.check_orderings(summary) == []
+
+
+def test_replay_ordering_check_detects_flip():
+    import copy
+    import json
+    from pathlib import Path
+
+    mod = _load_replay_module()
+    summary = json.loads(
+        (Path(__file__).parent.parent / "results" / "summary.json").read_text())
+    bad = copy.deepcopy(summary)
+    for r in bad:
+        if r["preset"] == "reference-dsgd-complete":
+            r["final_acc"] = 0.01
+    problems = mod.check_orderings(bad)
+    assert problems and any("reference-dsgd-complete" in p for p in problems)
+    # missing presets are reported, not silently passed
+    assert mod.check_orderings([]) != []
+
+
 def test_cli_seqlm_preset(tmp_path):
     """`--preset seqlm` drives the sequence-parallel LM engine through
     the same CLI surface as the reference engines (VERDICT r1 #8)."""

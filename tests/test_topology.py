@@ -148,6 +148,32 @@ def test_spectral_gap_ordering():
     assert complete.spectral_gap() > ring.spectral_gap()
 
 
+def test_spectral_gap_product_vs_mean_for_schedules():
+    n = 8
+    # Zero-diagonal single-edge rounds (reference 'dynamic' semantics)
+    # are pure model SWAPS — permutation matrices, so the schedule never
+    # contracts at all.  The per-period product exposes that (gap 0);
+    # the round-mean claims a healthy positive gap.  This is the case
+    # where the mean diagnostic actively misleads.
+    dyn_swap = build_mixing_matrices("dynamic", "uniform", n)
+    assert dyn_swap.spectral_gap(kind="mean") > 0.05
+    assert dyn_swap.spectral_gap() == pytest.approx(0.0, abs=1e-9)
+
+    # Self-inclusive dynamic rounds DO contract; per-round the schedule
+    # is still slower than a static metropolis ring (one edge per round
+    # vs all edges every round), and here the mean under-states it.
+    dyn = build_mixing_matrices("dynamic", "metropolis", n)
+    ring = build_mixing_matrices("circle", "metropolis", n)
+    dyn_per_round = 1.0 - (1.0 - dyn.spectral_gap()) ** (1.0 / len(dyn.matrices))
+    assert ring.spectral_gap() > dyn_per_round > 0
+
+    # Static schedule: both kinds agree exactly.
+    assert ring.spectral_gap() == pytest.approx(ring.spectral_gap(kind="mean"))
+
+    with pytest.raises(ValueError, match="kind"):
+        ring.spectral_gap(kind="nope")
+
+
 def test_stacked_shape():
     mm = build_mixing_matrices("dynamic", "stochastic", 6, seed=0)
     assert mm.stacked().shape == (6, 6, 6)
