@@ -240,21 +240,29 @@ class GossipTrainer:
                                                 extra_shifts=extra)
                    if flat_1d else None)
             if ids is not None and g.comm_impl == "auto":
-                # Take the ppermute path only when its ICI bytes beat the
-                # all_gather with a 2× margin: the folded decomposition
-                # ships only the lanes its shifts consume
-                # (shift_comm_lanes) vs the dense path's (n − L) remote
-                # lanes per device.  Ring/dynamic at any fold factor
-                # qualifies; complete graphs never do.
+                # Take the ppermute path only when it actually wins:
+                # (a) there IS a wire — on a 1-device mesh every "shift"
+                #     is a local lane slice and the dense tensordot is
+                #     strictly better (one gemm vs one sliced copy of
+                #     the stacked state PER shift, which OOMs ResNet-32
+                #     on a single chip);
+                # (b) the shift set is sparse (≤ max(3, w/2) diagonals —
+                #     ring/dynamic/torus yes, complete/random no: the
+                #     local mix work is linear in the shift count);
+                # (c) its ICI bytes beat the all_gather with a 2× margin
+                #     (shift_comm_lanes counts only the lanes shifts
+                #     consume, vs the dense (n − L) remote lanes), with
+                #     a floor of 3 shipped lanes so tiny rings — where
+                #     the margin can't hold numerically — keep the
+                #     stable ppermute routing.
                 from dopt.parallel.collectives import shift_comm_lanes
 
                 lanes = w // mesh.size
                 shipped = shift_comm_lanes(ids, lanes, mesh.size)
-                # Floor of 3 shipped lanes: tiny rings (n ≤ 4, where the
-                # 2× margin can't hold numerically) stay on the ppermute
-                # path — point-to-point neighbor traffic still beats a
-                # gather at equal bytes, and routing must be stable in n.
-                if shipped > 3 and 2 * shipped > max(w - lanes, 1):
+                if (mesh.size == 1
+                        or len(ids) > max(3, w // 2)
+                        or (shipped > 3
+                            and 2 * shipped > max(w - lanes, 1))):
                     ids = None
             if ids is not None:
                 self._shift_ids = ids

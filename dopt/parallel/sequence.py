@@ -58,6 +58,18 @@ def _combine(num1, den1, m1, num2, den2, m2):
     return num, den, m
 
 
+@jax.checkpoint
+def _block_attn_remat(q, k, v, scale, mask):
+    """``_block_attn`` under rematerialisation.  Used inside the ring /
+    KV-chunk scans: without remat, autodiff saves every iteration's
+    [B, Lq, H, Lk] score matrix as a scan residual, so the backward pass
+    holds O(L²) no matter how small the chunks are — the whole point of
+    blockwise attention evaporates.  Remat recomputes the scores from
+    (q, k, v) in the backward (the standard flash-attention trade:
+    ~⅓ more attention FLOPs for O(block·chunk) peak memory)."""
+    return _block_attn(q, k, v, scale=scale, mask=mask)
+
+
 def dense_attention(q, k, v, *, causal: bool = False):
     """Single-device exact attention — the correctness reference.
     q, k, v: [B, L, H, Dh]."""
@@ -93,7 +105,7 @@ def _block_attn_chunked(qb, kb_t, vb_t, *, scale, q_pos, k_pos0, chunk):
             mask = (q_pos[:, None] >= k_pos[None, :])[None, :, None, :]
         else:
             mask = None
-        num2, den2, m2 = _block_attn(qb, kb_c, vb_c, scale=scale, mask=mask)
+        num2, den2, m2 = _block_attn_remat(qb, kb_c, vb_c, scale, mask)
         return _combine(num, den, m, num2, den2, m2), None
 
     num0 = qb * 0
@@ -151,8 +163,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
                     mask = mask[None, :, None, :]            # [1, Lq, 1, Lk]
                 else:
                     mask = None
-                num2, den2, m2 = _block_attn(qb, kb_t, vb_t, scale=scale,
-                                             mask=mask)
+                num2, den2, m2 = _block_attn_remat(qb, kb_t, vb_t, scale,
+                                                   mask)
             num, den, m = _combine(num, den, m, num2, den2, m2)
 
             # Rotate KV to the next device — except after the last

@@ -7,8 +7,16 @@ code path, no hops); on an N-device mesh the KV pairs rotate over ICI.
 There is no reference counterpart (the reference has no sequence axis);
 the number is the framework's own long-context baseline.
 
-Usage: python scripts/bench_seqlm.py [--steps N] [--seq-len L] [--attn ring]
-Prints one JSON line: {"metric": "seqlm_tokens_per_sec", ...}.
+Point mode prints one JSON line:
+    python scripts/bench_seqlm.py [--steps N] [--seq-len L] [--kv-chunk C]
+
+Sweep mode (``--sweep``) doubles seq_len until the chip OOMs, with and
+without flash-style KV chunking (``SeqLMConfig.kv_chunk`` — the knob
+that turns the per-block score memory from O(block²) into
+O(block·chunk)), records tokens/sec + peak HBM per point, and writes
+``results/seqlm_bench.json`` with the longest trainable context per
+branch.  Each point runs in a SUBPROCESS so an OOM cannot poison the
+sweep's runtime state.
 """
 
 from __future__ import annotations
@@ -16,6 +24,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -23,14 +32,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--seq-len", type=int, default=2048)
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
-    args = ap.parse_args()
-
+def run_point(args) -> int:
     import jax
 
     from dopt.engine import SeqLMTrainer
@@ -39,7 +41,8 @@ def main() -> int:
     cfg = get_preset("seqlm")
     cfg = cfg.replace(seqlm=dataclasses.replace(
         cfg.seqlm, steps=args.steps, seq_len=args.seq_len, batch=args.batch,
-        attn=args.attn, log_every=max(args.steps // 3, 1)))
+        attn=args.attn, kv_chunk=args.kv_chunk,
+        log_every=max(args.steps // 3, 1)))
     tr = SeqLMTrainer(cfg)
     tr.run(steps=3)                       # compile + warmup
     t0 = time.time()
@@ -47,19 +50,105 @@ def main() -> int:
     jax.block_until_ready(tr.params)
     elapsed = time.time() - t0
     tokens = args.steps * args.batch * args.seq_len
-    print(json.dumps({
+    out = {
         "metric": "seqlm_tokens_per_sec",
         "value": round(tokens / elapsed, 1),
         "unit": "tokens/sec",
         "attn": args.attn,
         "seq_len": args.seq_len,
         "batch": args.batch,
+        "kv_chunk": args.kv_chunk,
         "mesh_devices": tr.mesh.size,
         "params": tr.param_count,
         "final_loss": round(tr.history.last()["loss"], 4),
         "device": str(jax.devices()[0].device_kind),
-    }))
+    }
+    stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
+    if stats and stats.get("peak_bytes_in_use"):
+        out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 3)
+    print(json.dumps(out))
     return 0
+
+
+def run_sweep(args) -> int:
+    """Double seq_len until OOM, for kv_chunk in (0, --kv-chunk)."""
+    if args.attn != "ring":
+        print(f"--sweep requires --attn ring (kv_chunk only applies to "
+              f"ring attention, got {args.attn!r})", file=sys.stderr)
+        return 2
+    points, longest = [], {}
+    for kv in (0, args.kv_chunk):
+        label = f"kv_chunk={kv}" if kv else "no chunking (O(block²) scores)"
+        for exp in range(100):
+            seq = args.seq_len << exp
+            if seq > args.max_seq_len:
+                break
+            cmd = [sys.executable, __file__, "--steps", str(args.steps),
+                   "--seq-len", str(seq), "--batch", str(args.batch),
+                   "--attn", args.attn, "--kv-chunk", str(kv)]
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=1800)
+            except subprocess.TimeoutExpired as e:
+                # A wedged point (e.g. runtime hang at the OOM boundary)
+                # ends its branch but must not lose the sweep so far.
+                points.append({"seq_len": seq, "kv_chunk": kv,
+                               "status": "timeout",
+                               "stderr_tail": str(e)[-400:]})
+                print(f"[sweep] {label} seq_len={seq}: TIMEOUT", flush=True)
+                break
+            line = next((ln for ln in r.stdout.splitlines()
+                         if ln.startswith("{")), None)
+            if r.returncode != 0 or line is None:
+                oom = ("RESOURCE_EXHAUSTED" in r.stderr
+                       or "out of memory" in r.stderr.lower())
+                points.append({"seq_len": seq, "kv_chunk": kv,
+                               "status": "oom" if oom else "failed",
+                               "stderr_tail": r.stderr.strip()[-400:]})
+                print(f"[sweep] {label} seq_len={seq}: "
+                      f"{'OOM' if oom else 'FAILED'}", flush=True)
+                break
+            p = json.loads(line)
+            p["status"] = "ok"
+            points.append(p)
+            longest[f"kv_chunk_{kv}"] = seq
+            print(f"[sweep] {label} seq_len={seq}: "
+                  f"{p['value']:,.0f} tok/s"
+                  + (f", peak HBM {p['peak_hbm_gb']} GB"
+                     if "peak_hbm_gb" in p else ""), flush=True)
+    payload = {
+        "suite": "seqlm long-context sweep",
+        "attn": args.attn,
+        "batch": args.batch,
+        "steps_per_point": args.steps,
+        "longest_trainable_seq_len": longest,
+        "points": points,
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(payload, indent=2) + "\n")
+    print(f"wrote {out}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    ap.add_argument("--kv-chunk", type=int, default=0,
+                    help="flash-style KV chunk (0 = full-block scores); "
+                         "in --sweep mode, the chunked branch's size")
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--max-seq-len", type=int, default=1 << 20)
+    ap.add_argument("--out", default="results/seqlm_bench.json")
+    args = ap.parse_args()
+    if args.sweep:
+        if not args.kv_chunk:
+            args.kv_chunk = 512
+        return run_sweep(args)
+    return run_point(args)
 
 
 if __name__ == "__main__":

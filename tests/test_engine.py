@@ -512,6 +512,16 @@ def test_comm_impl_auto_and_validation(devices):
     assert GossipTrainer(_shift_cfg(
         "auto", num_users=16,
         gossip=dict(topology="complete")))._shift_ids is None
+    # 1-device mesh: no wire to save — auto must stay dense (the shift
+    # path would materialise one sliced copy of the stacked state per
+    # diagonal; a 32-worker random graph OOMs a single chip that way).
+    assert GossipTrainer(_shift_cfg(
+        "auto", mesh_devices=1))._shift_ids is None
+    # dense shift set (random graph): local mix work is linear in the
+    # diagonal count -> dense even though lanes fold.
+    assert GossipTrainer(_shift_cfg(
+        "auto", num_users=32,
+        gossip=dict(topology="random", local_bs=8)))._shift_ids is None
     # explicit shift honors an expensive decomposition (complete = all 7).
     tr = GossipTrainer(_shift_cfg("shift", gossip=dict(topology="complete")))
     assert tr._shift_ids == tuple(range(1, 8))
