@@ -90,17 +90,23 @@ def _config(*, fast: bool, train_size: int, test_size: int,
     )
 
 
-def _measure(cfg, rounds: int, block: int):
-    """Warm up (compile), then time ``rounds`` rounds with evaluation
-    OUT of the measured loop (eval is a metric, not the workload; the
-    reference times its rounds the same way — eval cost is separate
-    from the local-SGD + consensus phases being compared).  Returns
-    (rounds/sec, post-run avg test acc, elapsed seconds, samples/sec)."""
+def _measure(cfg, rounds: int, block: int, repeats: int = 3):
+    """Warm up (compile), then time ``repeats`` independent blocks of
+    ``rounds`` rounds each and take the MEDIAN — the tunneled chip shows
+    ±8% wall-clock variance on identical code (VERDICT r3), so a single
+    window makes round-over-round comparisons noise-limited.  Evaluation
+    stays OUT of the measured loop (eval is a metric, not the workload;
+    the reference times its rounds the same way).  Returns (median
+    rounds/sec, post-run avg test acc, total measured seconds, median
+    samples/sec, spread_pct) where spread_pct = (max−min)/median·100
+    over the per-block rounds/sec."""
+    import statistics
+
     from dopt.engine import GossipTrainer
 
     # eval_every > total rounds dispatched => the measured block carries
     # zero eval steps (lax.cond skips the branch's work at runtime).
-    trainer = GossipTrainer(cfg, eval_every=10 * rounds + 97)
+    trainer = GossipTrainer(cfg, eval_every=10 * rounds * repeats + 97)
     # Warmup: compile the fused block step for every block size the
     # measured loop will dispatch (the remainder block retraces).
     trainer.run(rounds=block, block=block)
@@ -108,15 +114,21 @@ def _measure(cfg, rounds: int, block: int):
         trainer.run(rounds=rounds % block, block=block)
     import jax
 
-    t0 = time.time()
-    trainer.run(rounds=rounds, block=block)
-    jax.block_until_ready(trainer.params)
-    elapsed = time.time() - t0
+    rps = []
+    total = 0.0
+    for _ in range(repeats):
+        t0 = time.time()
+        trainer.run(rounds=rounds, block=block)
+        jax.block_until_ready(trainer.params)
+        elapsed = time.time() - t0
+        total += elapsed
+        rps.append(rounds / elapsed)
+    med = statistics.median(rps)
+    spread = 100.0 * (max(rps) - min(rps)) / med
     samples_per_round = (trainer.num_workers * cfg.gossip.local_ep
                          * trainer._train_matrix.shape[1])
     acc = float(trainer.evaluate()["acc"].mean())
-    return (rounds / elapsed, acc, elapsed,
-            rounds * samples_per_round / elapsed)
+    return med, acc, total, med * samples_per_round, spread
 
 
 def main() -> None:
@@ -129,6 +141,10 @@ def main() -> None:
                          "measured rounds in one fused lax.scan block)")
     ap.add_argument("--skip-faithful", action="store_true",
                     help="measure only the fast (bf16) mode")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="independent measured blocks; the reported value "
+                         "is their median (variance hardening: the tunneled "
+                         "chip shows ±8%% single-window wall-clock noise)")
     ap.add_argument("--idiomatic", action="store_true",
                     help="benchmark the idiomatic model head (post-conv "
                          "ReLUs, logit head + softmax-CE — faithful=False) "
@@ -147,10 +163,11 @@ def main() -> None:
     block = args.block if args.block is not None else rounds
 
     faithful_model = not args.idiomatic
-    fast_rps, fast_acc, fast_s, fast_sps = _measure(
+    repeats = 2 if args.smoke else args.repeats
+    fast_rps, fast_acc, fast_s, fast_sps, fast_spread = _measure(
         _config(fast=True, train_size=train_size, test_size=test_size,
                 faithful_model=faithful_model),
-        rounds, block)
+        rounds, block, repeats)
     kind, peak = _device_peak_flops()
     result = {
         "metric": "gossip_rounds_per_sec_dsgd_mnist_6workers_model1_bf16"
@@ -158,6 +175,9 @@ def main() -> None:
         "value": round(fast_rps, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(fast_rps / REFERENCE_ROUNDS_PER_SEC, 2),
+        "spread_pct": round(fast_spread, 2),
+        "measured_blocks": repeats,
+        "rounds_per_block": rounds,
         "fast_avg_test_acc": round(float(fast_acc), 4),
         "device_kind": kind,
         "samples_per_sec": round(fast_sps, 1),
@@ -168,19 +188,22 @@ def main() -> None:
         result["mfu_vs_bf16_peak"] = round(
             fast_sps * MODEL1_TRAIN_FLOPS_PER_SAMPLE / peak, 4)
     if not args.skip_faithful:
-        f_rps, f_acc, f_s, f_sps = _measure(
+        f_rps, f_acc, f_s, f_sps, f_spread = _measure(
             _config(fast=False, train_size=train_size, test_size=test_size,
                     faithful_model=faithful_model),
-            rounds, block)
+            rounds, block, repeats)
         result["faithful_f32_rounds_per_sec"] = round(f_rps, 4)
         result["faithful_f32_vs_baseline"] = round(
             f_rps / REFERENCE_ROUNDS_PER_SEC, 2)
         result["faithful_avg_test_acc"] = round(float(f_acc), 4)
         result["faithful_samples_per_sec"] = round(f_sps, 1)
-        print(f"# faithful f32: {rounds} rounds in {f_s:.2f}s "
-              f"(acc={f_acc:.4f}, {f_sps:,.0f} samples/s)", file=sys.stderr)
-    print(f"# fast bf16: {rounds} rounds in {fast_s:.2f}s "
-          f"(acc={fast_acc:.4f}, {fast_sps:,.0f} samples/s)", file=sys.stderr)
+        result["faithful_spread_pct"] = round(f_spread, 2)
+        print(f"# faithful f32: {repeats}x{rounds} rounds in {f_s:.2f}s "
+              f"(median, spread {f_spread:.1f}%; acc={f_acc:.4f}, "
+              f"{f_sps:,.0f} samples/s)", file=sys.stderr)
+    print(f"# fast bf16: {repeats}x{rounds} rounds in {fast_s:.2f}s "
+          f"(median, spread {fast_spread:.1f}%; acc={fast_acc:.4f}, "
+          f"{fast_sps:,.0f} samples/s)", file=sys.stderr)
     print(json.dumps(result))
 
 

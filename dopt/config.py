@@ -183,6 +183,15 @@ class GossipConfig:
     # contraction/ppermute, halving ICI/DCN bytes per gossip round;
     # params and local compute stay at their own dtype.  None =
     # communicate at the compute dtype.
+    #
+    # Determinism note: with comm_dtype set, the two comm_impl paths are
+    # NOT bit-identical — the dense path narrows every gathered lane,
+    # while the shift path keeps locally-sourced lanes (shift 0 and the
+    # q==0 parts of shifts that straddle a device's lane fold) exact.
+    # Compressed-mode results therefore depend on comm_impl AND on the
+    # mesh shape / lane fold (workers-per-device).  Exact-dtype runs
+    # (comm_dtype=None) are bit-identical across both paths and any
+    # fold — that equality is what the test suite pins.
     dropout: float = 0.0
     # Fault injection: per-round probability each worker is down.  Down
     # workers skip consensus AND local training for the round; the mixing

@@ -167,6 +167,69 @@ def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
     )
 
 
+def pick_gather_chunks(steps: int, *, workers: int, batch: int,
+                       sample_bytes: int,
+                       budget_bytes: int = 256 * 1024 * 1024) -> int | None:
+    """Choose how many chunks to split a [S, B] plan into so each chunk's
+    materialised batch slab ([W, S/k, B, sample]) fits ``budget_bytes``.
+
+    Rationale: gathering one minibatch per step inside the scan costs
+    ~250 µs of fixed gather overhead per step on a v5e (18% of device
+    time on the headline workload, results/trace_headline.json); one big
+    gather per chunk runs at memcpy speed.  Returns the smallest divisor
+    of ``steps`` whose slab fits, or None (meaning: keep the per-step
+    gather) when even per-step slabs would blow the budget — which
+    cannot happen in practice since k=steps is always a divisor.
+    """
+    for k in range(1, steps + 1):
+        if steps % k:
+            continue
+        if workers * (steps // k) * batch * sample_bytes <= budget_bytes:
+            return k
+    return None
+
+
+def _scan_steps_gathered(core, params, mom, idx, bw, train_x, train_y,
+                         theta, alpha, gather_chunks):
+    """Scan SGD steps over a [S, B] index plan against the resident train
+    arrays.  ``gather_chunks=None`` gathers each minibatch inside the
+    step body (O(B·|x|) live memory, one small gather per step);
+    ``gather_chunks=k`` splits S into k chunks and materialises each
+    chunk's batches with ONE big gather (O((S/k)·B·|x|) live memory) —
+    same indices, same order, bit-identical numerics, far less per-step
+    gather overhead."""
+
+    def step(carry, batch):
+        p, m = carry
+        x, y, w = batch
+        p, m, loss, acc = core(p, m, x, y, w, theta, alpha)
+        return (p, m), (loss, acc)
+
+    if gather_chunks is None:
+        def gstep(carry, batch):
+            p, m = carry
+            i, w = batch
+            p, m, loss, acc = core(p, m, train_x[i], train_y[i], w,
+                                   theta, alpha)
+            return (p, m), (loss, acc)
+
+        return jax.lax.scan(gstep, (params, mom), (idx, bw))
+
+    s, b = idx.shape
+    if s % gather_chunks:
+        raise ValueError(
+            f"gather_chunks={gather_chunks} does not divide steps={s}")
+    idx_c = idx.reshape(gather_chunks, s // gather_chunks, b)
+    bw_c = bw.reshape(gather_chunks, s // gather_chunks, b)
+
+    def chunk(carry, ch):
+        ci, cw = ch
+        return jax.lax.scan(step, carry, (train_x[ci], train_y[ci], cw))
+
+    carry, (losses, accs) = jax.lax.scan(chunk, (params, mom), (idx_c, bw_c))
+    return carry, (losses.reshape(s), accs.reshape(s))
+
+
 def make_local_update_gather(
     apply_fn: Callable,
     *,
@@ -176,13 +239,15 @@ def make_local_update_gather(
     rho: float = 0.0,
     l2: float = 0.0,
     update_impl: str = "jnp",
+    gather_chunks: int | None = None,
 ):
-    """Like ``make_local_update`` but gathers each minibatch from the full
-    on-device dataset inside the step scan: the caller passes the [S, B]
+    """Like ``make_local_update`` but gathers minibatches from the full
+    on-device dataset inside the scan: the caller passes the [S, B]
     index/weight plan plus the resident train arrays instead of
     materialised [S, B, ...] batches.  Peak activation memory drops from
-    O(S·B·|x|) to O(B·|x|), which is what lets the fused multi-round
-    block path keep K rounds of plans on device at once.
+    O(S·B·|x|) to O((S/k)·B·|x|) (k = ``gather_chunks``; None = one
+    small gather per step, O(B·|x|)), which is what lets the fused
+    multi-round block path keep K rounds of plans on device at once.
 
     Returns fn(params, mom, idx, bw, train_x, train_y, theta=None,
     alpha=None) -> (new_params, new_mom, losses[S], accs[S]).
@@ -195,13 +260,9 @@ def make_local_update_gather(
 
     def local_update(params, mom, idx, bw, train_x, train_y,
                      theta=None, alpha=None):
-        def step(carry, batch):
-            p, m = carry
-            i, w = batch
-            p, m, loss, acc = core(p, m, train_x[i], train_y[i], w, theta, alpha)
-            return (p, m), (loss, acc)
-
-        (params, mom), (losses, accs) = jax.lax.scan(step, (params, mom), (idx, bw))
+        (params, mom), (losses, accs) = _scan_steps_gathered(
+            core, params, mom, idx, bw, train_x, train_y, theta, alpha,
+            gather_chunks)
         return params, mom, losses, accs
 
     return local_update
@@ -209,12 +270,14 @@ def make_local_update_gather(
 
 def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
-                                     update_impl="jnp"):
+                                     update_impl="jnp",
+                                     gather_chunks=None):
     """vmap the gather-variant over the leading worker axis; train arrays
     and theta broadcast, ADMM duals stacked per worker."""
     fn = make_local_update_gather(apply_fn, lr=lr, momentum=momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
-                                  update_impl=update_impl)
+                                  update_impl=update_impl,
+                                  gather_chunks=gather_chunks)
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty: fn(p, m, idx, bw, tx, ty),
@@ -242,6 +305,7 @@ def make_local_update_epochs(
     rho: float = 0.0,
     l2: float = 0.0,
     update_impl: str = "jnp",
+    gather_chunks: int | None = None,
 ):
     """Local update with the reference's EPOCH structure: an outer scan
     over local epochs, each running its steps then evaluating the
@@ -286,8 +350,37 @@ def make_local_update_epochs(
                                          theta, alpha)
                 return (p_, m_), (loss, acc * w_.sum(), w_.sum())
 
-            (p, m), (losses, corrects, counts) = jax.lax.scan(
-                step, (p, m), (ei, ew))
+            def stepm(c, b):
+                p_, m_ = c
+                x, y, w_ = b
+                p_, m_, loss, acc = core(p_, m_, x, y, w_, theta, alpha)
+                return (p_, m_), (loss, acc * w_.sum(), w_.sum())
+
+            if gather_chunks is None:
+                (p, m), (losses, corrects, counts) = jax.lax.scan(
+                    step, (p, m), (ei, ew))
+            else:
+                # Chunked big-gather within the epoch: same indices, same
+                # order, one slab gather per chunk instead of one small
+                # gather per step (see _scan_steps_gathered).
+                se, bsz = ei.shape
+                if se % gather_chunks:
+                    raise ValueError(
+                        f"gather_chunks={gather_chunks} does not divide "
+                        f"steps/epoch={se}")
+                ei_c = ei.reshape(gather_chunks, se // gather_chunks, bsz)
+                ew_c = ew.reshape(ei_c.shape)
+
+                def chunk(c, ch):
+                    ci, cw = ch
+                    return jax.lax.scan(
+                        stepm, c, (train_x[ci], train_y[ci], cw))
+
+                (p, m), (losses, corrects, counts) = jax.lax.scan(
+                    chunk, (p, m), (ei_c, ew_c))
+                losses = losses.reshape(se)
+                corrects = corrects.reshape(se)
+                counts = counts.reshape(se)
             vm = ev(p, vx, vy, vw)
             em = {
                 "train_loss": losses.mean(),
@@ -306,13 +399,14 @@ def make_local_update_epochs(
 
 def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
-                                     update_impl="jnp"):
+                                     update_impl="jnp", gather_chunks=None):
     """vmap the epoch-structured update over the leading worker axis;
     train arrays and theta broadcast, per-worker plans / val stacks /
     ADMM duals stacked."""
     fn = make_local_update_epochs(apply_fn, lr=lr, momentum=momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
-                                  update_impl=update_impl)
+                                  update_impl=update_impl,
+                                  gather_chunks=gather_chunks)
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty, vi, vw_: fn(p, m, idx, bw, tx, ty,

@@ -38,6 +38,28 @@ def _head(x: jnp.ndarray, faithful: bool) -> jnp.ndarray:
     return x
 
 
+def _max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """2×2 stride-2 max pool via reshape + reduce_max.
+
+    Forward-identical to ``nn.max_pool(x, (2, 2), strides=(2, 2))`` for
+    even H/W (the windows are non-overlapping, so the reshape tiles them
+    exactly), but its VJP lowers to an elementwise equality-mask instead
+    of XLA's ``select_and_scatter`` — which the reduce_window backward
+    otherwise costs us ~12% of device time on the Model1 training step
+    (results/trace_headline.json).  Tie handling differs in theory
+    (gradient splits equally across tied window elements rather than
+    picking the first winner); on float conv activations ties are
+    measure-zero and the oracle parity suite stays green.
+
+    Odd spatial dims fall back to ``nn.max_pool`` (which floors), since
+    the reshape tiling requires even H/W.
+    """
+    b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+    return x.reshape(b, h // 2, 2, w // 2, 2, c).max(axis=(2, 4))
+
+
 class _ReferenceCNN(nn.Module):
     """Shared body of the reference's two CNNs (``models.py`` both
     projects): conv(·→32,k5,SAME) → maxpool2 → conv(32→64,k5,SAME) →
@@ -62,11 +84,11 @@ class _ReferenceCNN(nn.Module):
         x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
         if not self.faithful:
             x = nn.relu(x)
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = _max_pool_2x2(x)
         x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
         if not self.faithful:
             x = nn.relu(x)
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = _max_pool_2x2(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
         x = nn.relu(x)

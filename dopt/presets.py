@@ -191,6 +191,14 @@ PRESETS = {
         "dsgd", "circle", "double_stochastic"),
     "reference-dsgd-complete-double": lambda: reference_gossip(
         "dsgd", "complete", "double_stochastic"),
+    # The notebook's "dynamic"-mode run (Weighted Average.ipynb cell 29):
+    # args.mode='dynamic' matches NEITHER weight branch in
+    # communication_graph (simulators.py:65-85), so the raw 0/1
+    # adjacency of the still-'compelete' topology is used as the mixing
+    # matrix — unnormalised rows summing to n−1.  mode='ones' is dopt's
+    # explicit name for that quirk (dopt.topology; BASELINE.md row 0.32).
+    "reference-dsgd-dynamic": lambda: reference_gossip(
+        "dsgd", "complete", "ones"),
     "reference-fedlcon": lambda: reference_gossip("fedlcon", eps=5),
     "reference-gossip": lambda: reference_gossip("gossip"),
     "baseline1": baseline_1_ring_mnist_mlp,

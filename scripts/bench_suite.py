@@ -219,8 +219,13 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
     tfps = train_flops_per_sample(
         lambda p, x: trainer.model.apply({"params": p}, x), p0,
         cfg.model.input_shape)
-    flops_per_round = tfps * samples_per_round
-    kind, peak = device_peak_flops()
+    if tfps != tfps:  # NaN: backend returned no cost analysis — keep the
+        peak = None   # throughput numbers, drop the FLOP-derived fields.
+        flops_per_round = float("nan")
+        kind, _ = device_peak_flops()
+    else:
+        flops_per_round = tfps * samples_per_round
+        kind, peak = device_peak_flops()
 
     out = {
         "preset": name,
@@ -237,9 +242,6 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         # overhead without it)
         "tpu_rounds_per_sec": round(rps, 4),
         "tpu_samples_per_sec": round(sps, 1),
-        "train_flops_per_sample": round(tfps),
-        "flops_per_round": round(flops_per_round),
-        "model_tflops_per_sec": round(sps * tfps / 1e12, 3),
         "device_kind": kind,
         "compute_dtype": "bfloat16",
         # Measured-window phase attribution (PhaseTimers): round_step is
@@ -247,6 +249,10 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         # host_batch_plan the host-side planning.
         "phases": trainer.timers.summary(),
     }
+    if tfps == tfps:  # not NaN
+        out["train_flops_per_sample"] = round(tfps)
+        out["flops_per_round"] = round(flops_per_round)
+        out["model_tflops_per_sec"] = round(sps * tfps / 1e12, 3)
     if peak:
         out["mfu_vs_bf16_peak"] = round(sps * tfps / peak, 4)
     if not skip_oracle:
@@ -278,7 +284,8 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         #   flops_per_round ≥ 50 × (1/rps) × oracle_flops_per_sec,
         # which is reported so the gap is quantified, not hand-waved.
         oracle_fps = flops_per_round / oracle_s
-        out["oracle_flops_per_sec"] = round(oracle_fps)
+        if oracle_fps == oracle_fps:  # not NaN (cost analysis available)
+            out["oracle_flops_per_sec"] = round(oracle_fps)
         if peak:
             latency_bound = (sps * tfps / peak) < 0.01
             out["speedup_is_compute_comparison"] = not latency_bound

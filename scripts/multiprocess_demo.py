@@ -88,6 +88,26 @@ def child_main(args) -> int:
 
 
 def parent_main(args) -> int:
+    # The free-port probe below is inherently TOCTOU (the socket closes
+    # before the child coordinator binds) — retry the whole spawn with a
+    # fresh port if the coordinator loses the race.
+    diag = ""
+    for attempt in range(3):
+        rc, diag = _parent_attempt(args)
+        if rc != 3:  # 3 = coordinator bind failure (retryable)
+            return rc
+        print(f"coordinator port race (attempt {attempt + 1}/3), retrying "
+              "with a fresh port", file=sys.stderr)
+    # Out of retries: surface the last attempt's child output so a
+    # non-port failure that happened to match the bind heuristic is
+    # still diagnosable from the logs.
+    sys.stderr.write(f"--- last attempt child output ---\n{diag}\n")
+    print("FAIL: coordinator could not bind after 3 attempts",
+          file=sys.stderr)
+    return 1
+
+
+def _parent_attempt(args) -> tuple[int, str]:
     with socket.socket() as s:  # free port for the coordinator
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -122,16 +142,21 @@ def parent_main(args) -> int:
         for p in procs:
             p.kill()
         print("TIMEOUT: children killed", file=sys.stderr)
-        return 2
+        return 2, ""
 
     ok_lines = []
     for i, (rc, out) in enumerate(zip(rcs, outs)):
         marks = [ln for ln in out.splitlines() if OK_MARK in ln]
         ok_lines += marks
         if rc != 0 or not marks:
+            low = out.lower()
+            if "failed to bind" in low or "address already in use" in low:
+                # Retryable: another process grabbed the probed port.
+                # The caller prints this output if retries run out.
+                return 3, out
             sys.stderr.write(f"--- child {i} (rc={rc}) output ---\n{out}\n")
             print(f"FAIL: child {i} rc={rc} ok={bool(marks)}", file=sys.stderr)
-            return 1
+            return 1, out
         print(marks[0])
 
     # Determinism across the process boundary: every process must report
@@ -139,10 +164,10 @@ def parent_main(args) -> int:
     metrics = {ln.split(OK_MARK, 1)[1] for ln in ok_lines}
     if len(metrics) != 1:
         print(f"FAIL: processes disagree: {sorted(metrics)}", file=sys.stderr)
-        return 1
+        return 1, ""
     print(f"multiprocess demo OK: {args.num_processes} processes × "
           f"{args.devices_per_proc} devices, identical trajectories")
-    return 0
+    return 0, ""
 
 
 def main(argv=None) -> int:

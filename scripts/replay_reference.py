@@ -44,6 +44,10 @@ GOSSIP_GRID = [
      "dec_fed_avg_circle_double_stochastic_False_mnist", 0.38),
     ("reference-dsgd-complete-double",
      "dec_fed_avg_compelete_double_stochastic_False_mnist", 0.78),
+    # Cell 29's mode='dynamic' quirk run: raw 0/1 complete-graph weights
+    # (the reference's committed dec_fed_avg_dynamic_* CSVs are empty;
+    # the notebook cell output is the 0.32 baseline).
+    ("reference-dsgd-dynamic", "dec_fed_avg_dynamic_ones_False_mnist", 0.32),
     ("reference-fedlcon", "fedlcon_circle_stochastic_False_mnist", 0.74),
     ("reference-gossip", "gossip_learning_matching_False_mnist", None),
 ]

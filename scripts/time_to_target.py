@@ -45,33 +45,59 @@ def run_preset(name: str, *, target: float, quick: bool,
     budget = 20 if quick else cfg.gossip.rounds
     trainer = GossipTrainer(cfg, eval_every=1)
 
+    # Warmup block (UNTIMED for the steady rate, but real training —
+    # its rounds count toward the trajectory and the budget): carries
+    # the jit compile of the fused k-round block, so every measured
+    # block below is steady-state even when the target is reached (or
+    # the budget exhausted) within the first measured block.
+    warm_k = min(block, budget)
+    t0 = time.perf_counter()
+    trainer.run(rounds=warm_k, block=warm_k)
+    warm_s = time.perf_counter() - t0
+    done = warm_k
+
     block_times: list[tuple[int, float]] = []
-    done = 0
     reached_at = None
-    while done < budget:
-        k = min(block, budget - done)
-        t0 = time.perf_counter()
-        trainer.run(rounds=k, block=k)
-        block_times.append((k, time.perf_counter() - t0))
-        done += k
+
+    def _reached():
+        nonlocal reached_at
         accs = [r.get("avg_test_acc") for r in trainer.history.rows]
         if any(a is not None and a >= target for a in accs):
             reached_at = next(i for i, a in enumerate(accs)
                               if a is not None and a >= target)
-            break
+            return True
+        return False
 
-    # Steady-state seconds/round: exclude the compile-carrying first
-    # block; fall back to the overall mean if only one block ran.
-    if len(block_times) > 1:
-        steady = block_times[1:]
-        sec_per_round = sum(t for _, t in steady) / sum(k for k, _ in steady)
-    else:
-        sec_per_round = block_times[0][1] / block_times[0][0]
+    if not _reached():
+        while done < budget:
+            k = min(block, budget - done)
+            t0 = time.perf_counter()
+            trainer.run(rounds=k, block=k)
+            block_times.append((k, time.perf_counter() - t0))
+            done += k
+            if _reached():
+                break
+
+    # Snapshot the trajectory BEFORE any extra timing-only rounds so the
+    # artifact's accuracy fields describe exactly the reported run.
+    history_rows = list(trainer.history.rows)
+    accs = [r.get("avg_test_acc") for r in history_rows
+            if r.get("avg_test_acc") is not None]
+
+    # Steady-state seconds/round from the measured (post-warmup) blocks.
+    # If the warmup block alone reached the target, time one extra block
+    # of the same k — the trajectory is already decided, we only need an
+    # honest steady rate for the seconds axis (those extra rounds are
+    # excluded from the snapshot above).
+    if not block_times:
+        t0 = time.perf_counter()
+        trainer.run(rounds=warm_k, block=warm_k)
+        block_times.append((warm_k, time.perf_counter() - t0))
+    sec_per_round = (sum(t for _, t in block_times)
+                     / sum(k for k, _ in block_times))
 
     meter = time_to_target(trainer.history, target=target,
                            seconds_per_round=sec_per_round)
-    accs = [r.get("avg_test_acc") for r in trainer.history.rows
-            if r.get("avg_test_acc") is not None]
     return {
         "preset": name,
         "model": cfg.model.model,
@@ -81,11 +107,103 @@ def run_preset(name: str, *, target: float, quick: bool,
         "target_acc": target,
         "time_to_target": meter,
         "seconds_per_round_steady": round(sec_per_round, 4),
-        "first_block_seconds_incl_compile": round(block_times[0][1], 2),
+        "warmup_block_seconds_incl_compile": round(warm_s, 2),
         "rounds_run": done if reached_at is None else reached_at + 1,
         "final_acc": round(accs[-1], 4) if accs else None,
         "best_acc": round(max(accs), 4) if accs else None,
+        # per-round fleet-mean test acc (eval_every=1) — lets the oracle
+        # comparison read the TPU accuracy at the oracle's round index.
+        "acc_by_round": [round(a, 4) for a in accs],
     }
+
+
+def oracle_baseline(cfg, rounds: int) -> dict:
+    """Sequential torch-CPU run of the SAME config on the SAME synthetic
+    data for ``rounds`` rounds — the CPU-baseline accuracy anchor the
+    north-star phrasing compares against ("matching the CPU baseline's
+    final accuracy at ≥50× speedup", BASELINE.json).  Faithful to the
+    reference's round structure (two-phase consensus → local update,
+    ``simulators.py:136-167``); model init is torch's own seeded init
+    (distributionally equivalent — bitwise init parity is the
+    reference-surface oracle's job, tests/test_oracle_parity.py)."""
+    import numpy as np
+    import torch
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from bench_suite import _torch_model
+
+    from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
+    from dopt.engine.oracle import OracleWorker, consensus
+    from dopt.topology import build_mixing_matrices
+
+    g = cfg.gossip
+    w = cfg.data.num_users
+    ds = load_dataset(cfg.data.dataset, data_dir=cfg.data.data_dir,
+                      train_size=cfg.data.synthetic_train_size,
+                      test_size=cfg.data.synthetic_test_size, seed=cfg.seed)
+    _, index_matrix = partition(ds.train_y, w, iid=cfg.data.iid,
+                                shards_per_user=cfg.data.shards,
+                                seed=cfg.seed)
+    mixing = build_mixing_matrices(g.topology, g.mode, w, seed=cfg.seed)
+
+    def nchw(x):
+        return (np.ascontiguousarray(np.moveaxis(x, -1, -3))
+                if x.ndim >= 4 else x)
+
+    torch.manual_seed(cfg.seed)
+    proto = _torch_model(cfg.model, cfg.model.input_shape)
+    init = {k: v.clone() for k, v in proto.state_dict().items()}
+    workers = []
+    for _ in range(w):
+        m = _torch_model(cfg.model, cfg.model.input_shape)
+        m.load_state_dict({k: v.clone() for k, v in init.items()})
+        workers.append(OracleWorker(m, lr=cfg.optim.lr,
+                                    momentum=cfg.optim.momentum))
+
+    t_start = time.perf_counter()
+    for t in range(rounds):
+        w_t = mixing.for_round(t)
+        states = [wk.state() for wk in workers]
+        new = [consensus([(float(w_t[i, j]), states[j])
+                          for j in range(w) if w_t[i, j] > 0])
+               for i in range(w)]
+        for wk, st in zip(workers, new):
+            wk.load(st)
+        plan = make_batch_plan(index_matrix, batch_size=g.local_bs,
+                               local_ep=g.local_ep, seed=cfg.seed,
+                               round_idx=t, impl="numpy")
+        bx = nchw(ds.train_x[plan.idx])
+        by = ds.train_y[plan.idx]
+        for i in range(w):
+            workers[i].local_update(bx[i], by[i], plan.weight[i])
+    # One more consensus sweep (round `rounds`' mixing) before the final
+    # eval: the TPU engine's history row k is evaluated consensus-first
+    # (round order consensus → eval → local, gossip.py block_fn), so the
+    # comparable TPU number is acc_by_round[rounds] and this eval must
+    # sit at the same trajectory position — k local updates + the
+    # (k+1)-th consensus.
+    w_t = mixing.for_round(rounds)
+    states = [wk.state() for wk in workers]
+    new = [consensus([(float(w_t[i, j]), states[j])
+                      for j in range(w) if w_t[i, j] > 0])
+           for i in range(w)]
+    for wk, st in zip(workers, new):
+        wk.load(st)
+    ex, ey, ew = eval_batches(ds.test_x, ds.test_y, batch_size=256)
+    exn = nchw(ex)
+    accs = [wk.inference(exn, ey, ew)[0] for wk in workers]
+    return {"oracle_rounds": rounds,
+            "oracle_final_acc": round(float(np.mean(accs)), 4),
+            "oracle_seconds": round(time.perf_counter() - t_start, 1)}
+
+
+# Oracle (sequential torch-CPU) round caps: the comparison runs the
+# oracle for min(rounds the TPU run needed, cap) rounds and compares
+# fleet-mean accuracy AT THE SAME ROUND INDEX — apples-to-apples on
+# trajectory position.  baseline5's ResNet-18 round costs minutes of
+# CPU, hence the tighter cap (the truncation is recorded in the
+# artifact).
+ORACLE_CAPS = {"baseline2": 10, "baseline5": 2}
 
 
 def main() -> int:
@@ -94,20 +212,46 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="cap at 20 rounds per preset (machinery check)")
     ap.add_argument("--only", nargs="*", default=None)
+    ap.add_argument("--skip-oracle", action="store_true",
+                    help="skip the sequential torch-CPU baseline column")
     ap.add_argument("--out", default="results/time_to_target.json")
     args = ap.parse_args()
+
+    from dopt.presets import get_preset
 
     names = args.only or ["baseline2", "baseline5"]
     results = [run_preset(n, target=args.target, quick=args.quick)
                for n in names]
     for r in results:
+        if not args.skip_oracle:
+            cap = ORACLE_CAPS.get(r["preset"], 5)
+            # Oracle runs k rounds + the (k+1)-th consensus; the matching
+            # TPU number is acc_by_round[k] (consensus-first eval), so k
+            # must stay strictly below the TPU rounds run.
+            orounds = max(1, min(r["rounds_run"] - 1, cap,
+                                 2 if args.quick else 10**9))
+            om = oracle_baseline(get_preset(r["preset"]), orounds)
+            r.update(om)
+            k = om["oracle_rounds"]
+            tpu_at_k = (r["acc_by_round"][k]
+                        if len(r["acc_by_round"]) > k else None)
+            r["tpu_acc_at_oracle_round"] = tpu_at_k
+            if tpu_at_k is not None:
+                # The north-star accuracy claim, made checkable: the TPU
+                # run must not trail the CPU baseline by >0.5pt at the
+                # same trajectory position (tests/test_artifacts.py).
+                r["tpu_minus_oracle_acc"] = round(
+                    tpu_at_k - om["oracle_final_acc"], 4)
         m = r["time_to_target"]
         status = (f"reached at round {m['round']} "
                   f"(~{m['seconds']:.1f}s)" if m["reached"]
                   else f"not reached in {r['rounds_run']} rounds "
                        f"(best {r['best_acc']})")
         print(f"{r['preset']}: target {r['target_acc']} {status} "
-              f"[{r['seconds_per_round_steady']*1e3:.0f} ms/round steady]")
+              f"[{r['seconds_per_round_steady']*1e3:.0f} ms/round steady]"
+              + (f" oracle@{r['oracle_rounds']}r={r['oracle_final_acc']}"
+                 f" tpu@same={r.get('tpu_acc_at_oracle_round')}"
+                 if "oracle_final_acc" in r else ""))
 
     import jax
 
