@@ -70,6 +70,13 @@ class ModelConfig:
     # moves, at a numerics cost (the update itself then rounds to bf16
     # each step).  float32 is the oracle-parity mode.
     compute_dtype: str = "float32"   # "bfloat16" for the fast path
+    stacked_impl: str = "auto"
+    # How the engines execute the per-worker forward over the [W, ...]
+    # stacked state: "auto" uses the grouped-conv stacked program where
+    # one exists (model1/model3 — dopt.models.make_stacked_apply; ~3×
+    # faster than the vmap on TPU, identical math up to float
+    # reassociation inside the conv), "vmap" forces the vmapped
+    # per-worker path (the bit-level oracle-parity mode).
 
 
 @dataclass(frozen=True)

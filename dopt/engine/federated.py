@@ -40,7 +40,9 @@ import numpy as np
 from dopt.config import ExperimentConfig
 from dopt.data import (eval_batches, load_dataset, make_batch_plan,
                        partition, stacked_eval_batches)
-from dopt.engine.local import (make_evaluator, make_stacked_local_update,
+from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
+                               flat_input_stacked_apply, make_evaluator,
+                               make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                prepare_holdout, validate_optimizer)
 from dopt.models import build_model, count_params
@@ -107,7 +109,12 @@ class FederatedTrainer:
         # evaluates the client's own val split (the first 10%).
         self._holdout, self._train_matrix, self._val = prepare_holdout(
             cfg, self.index_matrix, self.mesh, batch_size=f.local_bs)
-        self._train_x = jnp.asarray(self.dataset.train_x)
+        # Resident train features stay FLAT on device (see
+        # flat_input_apply: shaped-row gathers are ~2.6× slower and
+        # poison downstream layouts on TPU).
+        self._sample_shape = self.dataset.train_x.shape[1:]
+        ntr = self.dataset.train_x.shape[0]
+        self._train_x = jnp.asarray(self.dataset.train_x.reshape(ntr, -1))
         self._train_y = jnp.asarray(self.dataset.train_y)
         ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
                                   batch_size=max(f.local_bs, 256))
@@ -161,11 +168,19 @@ class FederatedTrainer:
 
         local_algorithm = {"fedavg": "sgd", "fedprox": "fedprox",
                            "fedadmm": "fedadmm", "scaffold": "scaffold"}[f.algorithm]
+        # Grouped stacked-forward fast path (see gossip.py / zoo.py).
+        from dopt.models.zoo import resolve_stacked_apply
+
+        s_apply = resolve_stacked_apply(self.model, cfg.model.stacked_impl)
+        app_f = flat_input_apply(self.model.apply, self._sample_shape)
+        s_apply_f = (flat_input_stacked_apply(s_apply, self._sample_shape)
+                     if s_apply is not None else None)
         local = make_stacked_local_update(
-            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm=local_algorithm,
             rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
             update_impl="pallas" if cfg.optim.fused_update else "jnp",
+            stacked_apply=s_apply_f,
         )
         # Per-epoch big-gather chunking (see gossip.py: per-step gathers
         # carry ~250 µs fixed overhead each on a v5e; slab gathers don't).
@@ -180,13 +195,30 @@ class FederatedTrainer:
             spe, workers=w, batch=bs_eff, sample_bytes=sample_bytes)
         local_epochs = (
             make_stacked_local_update_epochs(
-                self.model.apply, lr=cfg.optim.lr,
+                app_f, lr=cfg.optim.lr,
                 momentum=cfg.optim.momentum, algorithm=local_algorithm,
                 rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
                 update_impl="pallas" if cfg.optim.fused_update else "jnp",
-                gather_chunks=epoch_chunks)
+                gather_chunks=epoch_chunks, stacked_apply=s_apply_f)
             if self._holdout else None
         )
+        if s_apply_f is not None and self.mesh.size > 1:
+            # Multi-device + grouped stacked forward: run the local phase
+            # under shard_map (dopt.parallel.mesh.shard_over_workers) —
+            # per-device lanes, local feature-group count, zero
+            # collectives.  Only the full-width path exists on a
+            # multi-device mesh (_use_compact), so every lane count here
+            # is the mesh-divisible W.  theta/c_global ride replicated,
+            # ADMM duals / SCAFFOLD client controls worker-sharded.
+            from dopt.parallel.mesh import shard_over_workers
+
+            extra = {"sgd": "", "fedprox": "r",
+                     "fedadmm": "rw", "scaffold": "rw"}[local_algorithm]
+            local = shard_over_workers(local, self.mesh,
+                                       "w" * 5 + extra, "w" * 4)
+            if local_epochs is not None:
+                local_epochs = shard_over_workers(
+                    local_epochs, self.mesh, "wwwwrrww" + extra, "www")
         use_holdout = self._holdout
         local_ep_n = f.local_ep
         global_eval = make_evaluator(self.model.apply)
@@ -359,10 +391,24 @@ class FederatedTrainer:
                           tweight)
 
         # Per-worker train-split eval: every input has a worker axis.
-        stacked_eval_perworker = jax.vmap(
-            lambda p, ex_, ey_, ew_: make_evaluator(self.model.apply)(p, ex_, ey_, ew_),
-            in_axes=(0, 0, 0, 0),
-        )
+        # Batches come from the FLAT resident train arrays (finish()
+        # gathers tx = train_x[tidx]), so both variants use the
+        # flat-row apply adapters.
+        if s_apply_f is not None:
+            def stacked_eval_perworker(p, ex_, ey_, ew_):
+                return _stacked_eval_scan(s_apply_f, p, ex_.swapaxes(0, 1),
+                                          ey_.swapaxes(0, 1),
+                                          ew_.swapaxes(0, 1))
+            if self.mesh.size > 1:
+                from dopt.parallel.mesh import shard_over_workers
+
+                stacked_eval_perworker = shard_over_workers(
+                    stacked_eval_perworker, self.mesh, "wwww", "w")
+        else:
+            stacked_eval_perworker = jax.vmap(
+                lambda p, ex_, ey_, ew_: make_evaluator(app_f)(p, ex_, ey_, ew_),
+                in_axes=(0, 0, 0, 0),
+            )
 
         def _take(tree, sel):
             return jax.tree.map(lambda x: x[sel], tree)

@@ -32,16 +32,18 @@ import numpy as np
 
 from dopt.config import ExperimentConfig
 from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
-from dopt.engine.local import (make_stacked_evaluator, make_stacked_local_update,
+from dopt.engine.local import (flat_input_apply, flat_input_stacked_apply,
+                               make_stacked_evaluator, make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                make_stacked_local_update_gather,
                                pick_gather_chunks, prepare_holdout,
                                validate_optimizer)
-from dopt.models import build_model, count_params
+from dopt.models import build_model, count_params, make_stacked_apply
 from dopt.parallel.collectives import (broadcast_to_workers, mix_dense,
                                        mix_shifts, where_mask)
-from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
-                                worker_axes, worker_sharding)
+from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
+                                shard_worker_tree, worker_axes,
+                                worker_sharding)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
                            coeffs_for_matrix, repair_for_dropout,
                            schedule_shift_decomposition)
@@ -151,7 +153,14 @@ class GossipTrainer:
         # every local epoch evaluates the worker's own val split.
         self._holdout, self._train_matrix, self._val = prepare_holdout(
             cfg, self.index_matrix, self.mesh, batch_size=g.local_bs)
-        self._train_x = jnp.asarray(self.dataset.train_x)
+        # Resident train features stay FLAT on device: TPU row-gathers
+        # from [N, H, W, C] with a tiny minor dim are far slower than
+        # from [N, F], and the shaped layout contaminates downstream
+        # ops (see flat_input_apply).  The local-update apply fns are
+        # wrapped to reshape rows at use.
+        self._sample_shape = self.dataset.train_x.shape[1:]
+        ntr = self.dataset.train_x.shape[0]
+        self._train_x = jnp.asarray(self.dataset.train_x.reshape(ntr, -1))
         self._train_y = jnp.asarray(self.dataset.train_y)
         ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
                                   batch_size=max(g.local_bs, 256))
@@ -218,20 +227,49 @@ class GossipTrainer:
             sample_bytes=sample_bytes)
         epoch_chunks = pick_gather_chunks(
             spe, workers=w, batch=bs_eff, sample_bytes=sample_bytes)
+        # Grouped stacked-forward fast path (make_stacked_apply): the
+        # whole fleet's forward as one feature-grouped conv program
+        # instead of vmap-over-workers (~3× step speedup on TPU).
+        from dopt.models.zoo import resolve_stacked_apply
+
+        self._stacked_apply = resolve_stacked_apply(self.model,
+                                                    cfg.model.stacked_impl)
+        s_apply = self._stacked_apply
+        # Flat-row adapters for everything that trains from the resident
+        # train arrays (the evaluators consume shaped host-built stacks
+        # and keep the raw apply).
+        app_f = flat_input_apply(self.model.apply, self._sample_shape)
+        s_apply_f = (flat_input_stacked_apply(s_apply, self._sample_shape)
+                     if s_apply is not None else None)
         local = make_stacked_local_update(
-            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
+            stacked_apply=s_apply_f,
         )
         local_epochs = (
             make_stacked_local_update_epochs(
-                self.model.apply, lr=cfg.optim.lr,
+                app_f, lr=cfg.optim.lr,
                 momentum=cfg.optim.momentum, algorithm="sgd", l2=l2,
-                update_impl=update_impl, gather_chunks=epoch_chunks)
+                update_impl=update_impl, gather_chunks=epoch_chunks,
+                stacked_apply=s_apply_f)
             if self._holdout else None
         )
+        if s_apply_f is not None and self.mesh.size > 1:
+            # The local phase is embarrassingly parallel across workers,
+            # so on a multi-device mesh the grouped-stacked update runs
+            # under shard_map (dopt.parallel.mesh.shard_over_workers):
+            # per-device lanes, local feature-group count, zero
+            # collectives.
+            local = shard_over_workers(local, self.mesh, "w" * 5, "w" * 4)
+            if local_epochs is not None:
+                local_epochs = shard_over_workers(
+                    local_epochs, self.mesh, "wwwwrrww", "www")
         use_holdout = self._holdout
         local_ep_n = g.local_ep
-        evaluator = make_stacked_evaluator(self.model.apply)
+        evaluator = make_stacked_evaluator(self.model.apply,
+                                           stacked_apply=s_apply)
+        if s_apply is not None and self.mesh.size > 1:
+            evaluator = shard_over_workers(evaluator, self.mesh, "wrrr", "w")
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
         is_choco = g.algorithm == "choco"
@@ -430,10 +468,13 @@ class GossipTrainer:
         self._evaluator = evaluator
         self._do_mix, self._eps = do_mix, eps
         self._local_gather = make_stacked_local_update_gather(
-            self.model.apply, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
+            app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
-            gather_chunks=self._gather_chunks,
+            gather_chunks=self._gather_chunks, stacked_apply=s_apply_f,
         )
+        if s_apply_f is not None and self.mesh.size > 1:
+            self._local_gather = shard_over_workers(
+                self._local_gather, self.mesh, "wwwwrr", "w" * 4)
         local_g, ev = self._local_gather, self._evaluator
 
         def block_fn(params, mom, x_hat, w_mats, alive, ts, idx, bw, is_eval,
@@ -697,6 +738,9 @@ class GossipTrainer:
 
     # Convenience: per-worker eval of the current state.
     def evaluate(self) -> dict[str, np.ndarray]:
-        evaluator = make_stacked_evaluator(self.model.apply)
+        evaluator = make_stacked_evaluator(self.model.apply,
+                                           stacked_apply=self._stacked_apply)
+        if self._stacked_apply is not None and self.mesh.size > 1:
+            evaluator = shard_over_workers(evaluator, self.mesh, "wrrr", "w")
         out = jax.jit(evaluator)(self.params, *self._eval)
         return {k: np.asarray(v) for k, v in out.items()}

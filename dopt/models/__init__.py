@@ -6,6 +6,7 @@ from dopt.models.zoo import (
     ResNet18,
     build_model,
     count_params,
+    make_stacked_apply,
 )
 from dopt.models.losses import cross_entropy, accuracy
 
@@ -17,6 +18,7 @@ __all__ = [
     "ResNet18",
     "build_model",
     "count_params",
+    "make_stacked_apply",
     "cross_entropy",
     "accuracy",
 ]

@@ -52,3 +52,32 @@ def l2_regulariser(params, lam: float) -> jnp.ndarray:
     sq = sum(jnp.sum(p.astype(jnp.float32) ** 2)
              for p in jax.tree_util.tree_leaves(params))
     return 0.5 * lam * sq
+
+
+def cross_entropy_stacked(outputs: jnp.ndarray, labels: jnp.ndarray,
+                          weights: jnp.ndarray) -> jnp.ndarray:
+    """Per-worker ``cross_entropy``: [W, B, C] outputs → [W] losses.
+    Same math as the vmapped per-worker call, reduced over the batch
+    axis only — used by the grouped stacked-forward fast path."""
+    logp = jax.nn.log_softmax(outputs.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    return jnp.sum(nll * w, axis=-1) / jnp.maximum(jnp.sum(w, axis=-1), 1.0)
+
+
+def accuracy_stacked(outputs: jnp.ndarray, labels: jnp.ndarray,
+                     weights: jnp.ndarray) -> jnp.ndarray:
+    """Per-worker ``accuracy``: [W, B, C] outputs → [W] fractions."""
+    correct = (jnp.argmax(outputs, axis=-1) == labels).astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    return (jnp.sum(correct * w, axis=-1)
+            / jnp.maximum(jnp.sum(w, axis=-1), 1.0))
+
+
+def l2_stacked(params, lam: float) -> jnp.ndarray:
+    """Per-worker ℓ2 penalty over a [W, ...]-stacked pytree → [W]."""
+    tot = 0.0
+    for p in jax.tree_util.tree_leaves(params):
+        tot = tot + (p.astype(jnp.float32) ** 2).reshape(p.shape[0], -1).sum(axis=1)
+    return 0.5 * lam * tot

@@ -73,6 +73,38 @@ def shard_worker_tree(tree, mesh: Mesh):
     return jax.tree.map(put, tree)
 
 
+def shard_over_workers(fn, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` a stacked-worker function over the mesh.
+
+    Specs are strings with one character per argument/output — ``w``
+    (leading worker axis sharded over all mesh axes) or ``r``
+    (replicated); each character acts as a pytree prefix for its
+    argument.  A single-character string means ONE spec (e.g. an
+    evaluator returning a metrics dict uses out_specs="w").  Used by
+    the engines to run the grouped stacked-forward local phase as pure
+    per-device computation (workers are independent — zero
+    collectives), which also keeps the worker-in-channels grouped conv
+    out of the SPMD partitioner's hands (it cannot split that conv's
+    feature groups itself).
+    """
+    w_, r_ = P(worker_axes(mesh)), P()
+
+    def one(c):
+        if c == "w":
+            return w_
+        if c == "r":
+            return r_
+        raise ValueError(f"spec characters are 'w' or 'r', got {c!r}")
+
+    def resolve(spec):
+        if len(spec) == 1:
+            return one(spec)
+        return tuple(one(c) for c in spec)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=resolve(in_specs),
+                         out_specs=resolve(out_specs), check_vma=False)
+
+
 def make_worker_mesh(num_workers: int, mesh_devices: int | None = None,
                      mesh_hosts: int | None = None) -> Mesh:
     """The engines' mesh factory: 1-D worker mesh by default, 2-D
