@@ -148,7 +148,11 @@ class FederatedTrainer:
 
         self._replicated = replicated_sharding(self.mesh)
         self.theta = jax.device_put(theta0, self._replicated)
-        stacked = jax.device_get(broadcast_to_workers(theta0, w))
+        # Host-side broadcast from the single init — one |θ| fetch, not
+        # a W·|θ| device→host round-trip (see gossip.py).
+        t_host = jax.device_get(theta0)
+        stacked = jax.tree.map(
+            lambda x: np.broadcast_to(x[None], (w,) + x.shape), t_host)
         self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
             jax.tree.map(np.zeros_like, stacked), self.mesh)
@@ -393,10 +397,14 @@ class FederatedTrainer:
         # Per-worker train-split eval: every input has a worker axis.
         # Batches come from the FLAT resident train arrays (finish()
         # gathers tx = train_x[tidx]), so both variants use the
-        # flat-row apply adapters.
-        if s_apply_f is not None:
+        # flat-row apply adapters.  Params arrive in STANDARD layout
+        # (the round's new_p), so the eval uses the standard stacked
+        # apply even when the training loop runs the fast-layout codec.
+        if s_apply is not None:
+            s_eval_f = flat_input_stacked_apply(s_apply, self._sample_shape)
+
             def stacked_eval_perworker(p, ex_, ey_, ew_):
-                return _stacked_eval_scan(s_apply_f, p, ex_.swapaxes(0, 1),
+                return _stacked_eval_scan(s_eval_f, p, ex_.swapaxes(0, 1),
                                           ey_.swapaxes(0, 1),
                                           ew_.swapaxes(0, 1))
             if self.mesh.size > 1:

@@ -38,9 +38,8 @@ from dopt.engine.local import (flat_input_apply, flat_input_stacked_apply,
                                make_stacked_local_update_gather,
                                pick_gather_chunks, prepare_holdout,
                                validate_optimizer)
-from dopt.models import build_model, count_params, make_stacked_apply
-from dopt.parallel.collectives import (broadcast_to_workers, mix_dense,
-                                       mix_shifts, where_mask)
+from dopt.models import build_model, count_params
+from dopt.parallel.collectives import mix_dense, mix_shifts, where_mask
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
                                 worker_sharding)
@@ -180,16 +179,22 @@ class GossipTrainer:
         pdt = jnp.dtype(cfg.model.param_dtype)
         params0 = jax.tree.map(lambda x: x.astype(pdt), params0)
         self.param_count = count_params(params0)
-        stacked = broadcast_to_workers(params0, w)
-        self.params = shard_worker_tree(jax.device_get(stacked), self.mesh)
+        # Broadcast to the fleet HOST-SIDE from the single-worker init:
+        # fetching only |θ| over the (slow) device→host tunnel instead
+        # of round-tripping the full W·|θ| stacked tree (1.4 GB for the
+        # 32-worker ResNet — construction-time, not training-time, but
+        # minutes of wall-clock through a degraded link).
+        p_host = jax.device_get(params0)
+        stacked = jax.tree.map(
+            lambda x: np.broadcast_to(x[None], (w,) + x.shape), p_host)
+        self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
-            jax.tree.map(np.zeros_like, jax.device_get(stacked)), self.mesh
+            jax.tree.map(np.zeros_like, stacked), self.mesh
         )
         # CHOCO-SGD "public copy" state x̂ (what the fleet believes each
         # worker's params are, updated only by compressed q exchanges).
         self.x_hat = (
-            shard_worker_tree(
-                jax.tree.map(np.zeros_like, jax.device_get(stacked)), self.mesh)
+            shard_worker_tree(jax.tree.map(np.zeros_like, stacked), self.mesh)
             if g.algorithm == "choco" else {}
         )
 
@@ -237,7 +242,11 @@ class GossipTrainer:
         s_apply = self._stacked_apply
         # Flat-row adapters for everything that trains from the resident
         # train arrays (the evaluators consume shaped host-built stacks
-        # and keep the raw apply).
+        # and keep the raw apply).  (A fast-layout param codec that
+        # hoists the per-step kernel relayout out of the scan was
+        # measured and REJECTED: carried grouped-layout kernels make
+        # XLA pick worse conv layouts — headline 378→401 ms/round,
+        # baseline5 2410→2572 ms/round device time.)
         app_f = flat_input_apply(self.model.apply, self._sample_shape)
         s_apply_f = (flat_input_stacked_apply(s_apply, self._sample_shape)
                      if s_apply is not None else None)
@@ -736,11 +745,8 @@ class GossipTrainer:
         if meta.get("dropout_rng_state"):
             self._dropout_rng.bit_generator.state = meta["dropout_rng_state"]
 
-    # Convenience: per-worker eval of the current state.
+    # Convenience: per-worker eval of the current state (reuses the
+    # round step's evaluator — same wrapping, same jit cache).
     def evaluate(self) -> dict[str, np.ndarray]:
-        evaluator = make_stacked_evaluator(self.model.apply,
-                                           stacked_apply=self._stacked_apply)
-        if self._stacked_apply is not None and self.mesh.size > 1:
-            evaluator = shard_over_workers(evaluator, self.mesh, "wrrr", "w")
-        out = jax.jit(evaluator)(self.params, *self._eval)
+        out = jax.jit(self._evaluator)(self.params, *self._eval)
         return {k: np.asarray(v) for k, v in out.items()}

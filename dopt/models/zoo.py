@@ -258,6 +258,198 @@ class TransformerLM(nn.Module):
         return _head(logits, self.faithful)
 
 
+def _to_grouped_kernel(k):
+    """[W, kh, kw, Cin, Cout] stacked conv kernel → the grouped-conv
+    layout [kh, kw, Cin, W·Cout] (worker-major output channels).  A
+    pure permutation — bit-exactly invertible."""
+    g = jnp.moveaxis(k, 0, 3)
+    return g.reshape(*g.shape[:3], -1)
+
+
+def _conv_fast(z, g_kernel, groups, *, dtype, strides=(1, 1),
+               padding="SAME", bias=None):
+    """Worker-grouped conv on [B, H, Wd, G·Cin] with a pre-grouped
+    [kh, kw, Cin, G·Cout] kernel (``_to_grouped_kernel`` layout)."""
+    out = jax.lax.conv_general_dilated(
+        z, g_kernel.astype(dtype), strides, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.astype(dtype).reshape(1, 1, 1, -1)
+    return out
+
+
+def _group_norm_stacked(z, scale, bias, *, num_workers, groups_per_worker,
+                        eps=1e-6):
+    """flax ``GroupNorm`` over worker-major stacked channels.
+
+    z is [B, H, Wd, W·C]; with worker-major channel packing the W·g
+    stacked groups tile exactly into per-worker channel blocks, so each
+    group's statistics are computed within one worker — identical math
+    to vmapping GroupNorm(num_groups=g) per worker.
+
+    Statistics use float32 ACCUMULATION (``jnp.mean(..., dtype=f32)``
+    with flax's E[x²]−E[x]² formula) but the big activation tensor is
+    never materialised in f32: the normalisation collapses to one fused
+    ``z·a + c`` in the compute dtype with per-(sample, channel) f32
+    coefficients — an explicit f32 upcast of the activations here cost
+    41% of baseline5's device time as convert_element_type ops.
+    """
+    b, h, wd, wc = z.shape
+    g = num_workers * groups_per_worker
+    cpg = wc // g
+    zg = z.reshape(b, h, wd, g, cpg)
+    mean = jnp.mean(zg, axis=(1, 2, 4), dtype=jnp.float32)          # [b, g]
+    mean2 = jnp.mean(jnp.square(zg), axis=(1, 2, 4), dtype=jnp.float32)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)                                   # [b, g]
+    inv_c = jnp.broadcast_to(inv[:, :, None], (b, g, cpg)).reshape(b, wc)
+    mean_c = jnp.broadcast_to(mean[:, :, None], (b, g, cpg)).reshape(b, wc)
+    sc = scale.reshape(wc).astype(jnp.float32)[None]
+    bi = bias.reshape(wc).astype(jnp.float32)[None]
+    a = (sc * inv_c).astype(z.dtype)
+    c0 = (bi - mean_c * inv_c * sc).astype(z.dtype)
+    return z * a[:, None, None, :] + c0[:, None, None, :]
+
+
+def _map_named_kernels(tree, ndim, fn):
+    """Recursively apply ``fn`` to every dict value under key 'kernel'
+    whose rank is ``ndim``; everything else passes through."""
+    if isinstance(tree, dict):
+        return {k: (fn(v) if k == "kernel" and getattr(v, "ndim", 0) == ndim
+                    else _map_named_kernels(v, ndim, fn))
+                for k, v in tree.items()}
+    return tree
+
+
+def _make_stacked_resnet_apply(model: "ResNet18"):
+    """Grouped-stacked forward for the GroupNorm ResNet-18 (the
+    north-star config's model): every conv becomes a
+    feature_group_count=W conv over worker-major channels, GroupNorm
+    becomes W·32 stacked groups, and the head a batched einsum.
+
+    The conv kernels are permuted into the grouped layout
+    (``_to_grouped_kernel``) at the top of each apply; hoisting that
+    relayout out of the step by CARRYING grouped-layout params through
+    the scan was measured and rejected — XLA then picks worse layouts
+    for the carried kernels (headline 378→401 ms/round, baseline5
+    2410→2572 ms/round device time).
+    """
+    dtype, faithful = model.dtype, model.faithful
+    stage_sizes = tuple(model.stage_sizes)
+
+    def apply(params, x):
+        fp = _map_named_kernels(params, 5, _to_grouped_kernel)
+        w, b = x.shape[0], x.shape[1]
+        z = jnp.moveaxis(x.astype(dtype), 0, 3)
+        z = z.reshape(*z.shape[:3], -1)
+        z = _conv_fast(z, fp["Conv_0"]["kernel"], w, dtype=dtype)
+        gn = fp["GroupNorm_0"]
+        z = _group_norm_stacked(z, gn["scale"], gn["bias"], num_workers=w,
+                                groups_per_worker=32)
+        z = nn.relu(z)
+        blk = 0
+        for stage, blocks in enumerate(stage_sizes):
+            for bi in range(blocks):
+                strides = 2 if (stage > 0 and bi == 0) else 1
+                bp = fp[f"ResidualBlock_{blk}"]
+                blk += 1
+                gpw = min(32, bp["Conv_0"]["kernel"].shape[-1] // w)
+                residual = z
+                y = _conv_fast(z, bp["Conv_0"]["kernel"], w, dtype=dtype,
+                               strides=(strides, strides))
+                y = _group_norm_stacked(
+                    y, bp["GroupNorm_0"]["scale"], bp["GroupNorm_0"]["bias"],
+                    num_workers=w, groups_per_worker=gpw)
+                y = nn.relu(y)
+                y = _conv_fast(y, bp["Conv_1"]["kernel"], w, dtype=dtype)
+                y = _group_norm_stacked(
+                    y, bp["GroupNorm_1"]["scale"], bp["GroupNorm_1"]["bias"],
+                    num_workers=w, groups_per_worker=gpw)
+                if "Conv_2" in bp:
+                    residual = _conv_fast(
+                        residual, bp["Conv_2"]["kernel"], w, dtype=dtype,
+                        strides=(strides, strides))
+                    residual = _group_norm_stacked(
+                        residual, bp["GroupNorm_2"]["scale"],
+                        bp["GroupNorm_2"]["bias"], num_workers=w,
+                        groups_per_worker=gpw)
+                z = nn.relu(y + residual)
+        z = jnp.mean(z, axis=(1, 2))                 # [B, W·C]
+        z = z.reshape(b, w, -1)
+        hd = fp["head"]
+        z = (jnp.einsum("bwi,wio->bwo", z, hd["kernel"].astype(dtype))
+             + hd["bias"].astype(dtype)[None])
+        z = jnp.moveaxis(z, 1, 0)                    # [W, B, ncls]
+        return _head(z, faithful)
+
+    return apply
+
+
+def _make_stacked_cnn_apply(model: "_ReferenceCNN"):
+    """Grouped-stacked forward for the reference CNNs.
+
+    The conv kernels are permuted to the grouped layout AND the FC
+    kernels reshaped to their VALID-conv form at the top of each apply
+    — a Dense over the flattened [H', Wd', C2] is exactly an H'×Wd'
+    VALID conv, and keeping the worker axis in channels end-to-end
+    avoids a [W·B·3136] activation relayout between conv and FC whose
+    forward+backward transposes cost ~2× the conv time in the einsum
+    formulation (measured on v5e).  flax flattens [H', Wd', C2]
+    row-major, so the [W, H'·Wd'·C2, O] kernel reshapes to
+    [W, H', Wd', C2, O] with matching index order.  (Carrying the
+    grouped layout through the training scan instead was measured and
+    rejected — see ``_make_stacked_resnet_apply``.)
+    """
+    faithful, dtype = model.faithful, model.dtype
+
+    def to_fast(p):
+        c2n = p["conv2"]["kernel"].shape[-1]
+        f1 = p["fc1"]["kernel"]           # [W, H'·Wd'·C2, hidden]
+        hw = int(round((f1.shape[1] // c2n) ** 0.5))
+        f2 = p["fc2"]["kernel"]           # [W, hidden, ncls]
+        return {
+            "conv1": {"kernel": _to_grouped_kernel(p["conv1"]["kernel"]),
+                      "bias": p["conv1"]["bias"]},
+            "conv2": {"kernel": _to_grouped_kernel(p["conv2"]["kernel"]),
+                      "bias": p["conv2"]["bias"]},
+            "fc1": {"kernel": _to_grouped_kernel(
+                        f1.reshape(f1.shape[0], hw, hw, c2n, f1.shape[2])),
+                    "bias": p["fc1"]["bias"]},
+            "fc2": {"kernel": _to_grouped_kernel(
+                        f2.reshape(f2.shape[0], 1, 1, *f2.shape[1:])),
+                    "bias": p["fc2"]["bias"]},
+        }
+
+    def apply(params, x):
+        fp = to_fast(params)
+        w, b = x.shape[0], x.shape[1]
+        # [W, B, H, Wd, C] → [B, H, Wd, W·C] (worker-major channels)
+        z = jnp.moveaxis(x.astype(dtype), 0, 3)
+        z = z.reshape(*z.shape[:3], -1)
+        z = _conv_fast(z, fp["conv1"]["kernel"], w, dtype=dtype,
+                       bias=fp["conv1"]["bias"])
+        if not faithful:
+            z = nn.relu(z)
+        z = _max_pool_2x2(z)
+        z = _conv_fast(z, fp["conv2"]["kernel"], w, dtype=dtype,
+                       bias=fp["conv2"]["bias"])
+        if not faithful:
+            z = nn.relu(z)
+        z = _max_pool_2x2(z)          # [B, H', Wd', W·C2]
+        z = _conv_fast(z, fp["fc1"]["kernel"], w, dtype=dtype,
+                       padding="VALID", bias=fp["fc1"]["bias"])
+        z = nn.relu(z)
+        z = _conv_fast(z, fp["fc2"]["kernel"], w, dtype=dtype,
+                       padding="VALID", bias=fp["fc2"]["bias"])
+        ncls = z.shape[-1] // w
+        z = z.reshape(b, w, ncls)
+        z = jnp.moveaxis(z, 1, 0)                 # [W, B, ncls]
+        return _head(z, faithful)
+
+    return apply
+
+
 def resolve_stacked_apply(model, stacked_impl: str):
     """Validate ``ModelConfig.stacked_impl`` and resolve the grouped
     stacked forward for it — the one shared entry point both engines
@@ -269,8 +461,9 @@ def resolve_stacked_apply(model, stacked_impl: str):
 
 
 def make_stacked_apply(model) -> "callable | None":
-    """Stacked-worker forward for the reference CNNs as ONE grouped-conv
-    program — the engine's fast path around ``vmap(model.apply)``.
+    """Stacked-worker forward for the reference CNNs and the ResNet as
+    ONE grouped-conv program — the engine's fast path around
+    ``vmap(model.apply)``.
 
     XLA lowers a conv vmapped over per-worker kernels poorly on TPU
     (layout shuffles around every conv; measured 1.6× step slowdown at
@@ -280,9 +473,8 @@ def make_stacked_apply(model) -> "callable | None":
     [B, H, Wd, W·C]) and concatenate the per-worker kernels into
     [kh, kw, C, W·Cout] — group w then convolves worker w's channels
     with worker w's kernel, which is precisely the stacked-fleet
-    forward.  The FC layers stay batched einsums (MXU-native under
-    batching).  Prototype measurement: 0.43 ms vs 1.43 ms per fused
-    train step on the headline workload (v5e).
+    forward.  Prototype measurement: 0.43 ms vs 1.43 ms per fused train
+    step on the headline workload (v5e).
 
     Returns ``apply(stacked_params, x)`` mapping a [W, ...]-stacked
     param pytree (the engine's native layout) and [W, B, H, Wd, C]
@@ -291,57 +483,11 @@ def make_stacked_apply(model) -> "callable | None":
     or ``None`` for models without a grouped-stacked form (the engines
     fall back to vmap).
     """
-    if not isinstance(model, _ReferenceCNN):
-        return None
-    faithful, dtype = model.faithful, model.dtype
-
-    def conv_grouped(z, kernel, bias, groups, padding="SAME"):
-        """z [B, H, Wd, G·Cin], kernel [G, kh, kw, Cin, Cout]."""
-        g_kernel = jnp.moveaxis(kernel.astype(dtype), 0, 3)
-        g_kernel = g_kernel.reshape(*g_kernel.shape[:3], -1)  # [kh,kw,Cin,G·Cout]
-        out = jax.lax.conv_general_dilated(
-            z, g_kernel, (1, 1), padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=groups)
-        return out + bias.astype(dtype).reshape(1, 1, 1, -1)
-
-    def apply(params, x):
-        w, b = x.shape[0], x.shape[1]
-        # [W, B, H, Wd, C] → [B, H, Wd, W·C] (worker-major channels)
-        z = jnp.moveaxis(x.astype(dtype), 0, 3)
-        z = z.reshape(*z.shape[:3], -1)
-        c1, c2 = params["conv1"], params["conv2"]
-        z = conv_grouped(z, c1["kernel"], c1["bias"], w)
-        if not faithful:
-            z = nn.relu(z)
-        z = _max_pool_2x2(z)
-        z = conv_grouped(z, c2["kernel"], c2["bias"], w)
-        if not faithful:
-            z = nn.relu(z)
-        z = _max_pool_2x2(z)          # [B, H', Wd', W·C2]
-        h_, wd_ = z.shape[1], z.shape[2]
-        c2n = z.shape[3] // w
-        # The FC layers stay grouped convs too — a Dense over the
-        # flattened [H', Wd', C2] is exactly a VALID H'×Wd' conv, and
-        # keeping the worker axis in channels end-to-end avoids a
-        # [W·B·3136] activation relayout between conv and FC whose
-        # forward+backward transposes alone cost ~2× the conv time in
-        # the einsum formulation (measured on v5e).
-        f1, f2 = params["fc1"], params["fc2"]
-        hidden = f1["kernel"].shape[-1]
-        # flax flattens [H', Wd', C2] row-major, so [W, H'·Wd'·C2, O]
-        # reshapes to [W, H', Wd', C2, O] with matching index order.
-        f1k = f1["kernel"].reshape(w, h_, wd_, c2n, hidden)
-        z = conv_grouped(z, f1k, f1["bias"], w, "VALID")  # [B, 1, 1, W·hidden]
-        z = nn.relu(z)
-        ncls = f2["kernel"].shape[-1]
-        f2k = f2["kernel"].reshape(w, 1, 1, hidden, ncls)
-        z = conv_grouped(z, f2k, f2["bias"], w, "VALID")  # [B, 1, 1, W·ncls]
-        z = z.reshape(b, w, ncls)
-        z = jnp.moveaxis(z, 1, 0)                 # [W, B, ncls]
-        return _head(z, faithful)
-
-    return apply
+    if isinstance(model, ResNet18):
+        return _make_stacked_resnet_apply(model)
+    if isinstance(model, _ReferenceCNN):
+        return _make_stacked_cnn_apply(model)
+    return None
 
 
 _ZOO = {

@@ -56,8 +56,49 @@ def test_forward_parity(model_name, faithful):
 
 
 def test_unsupported_models_return_none():
-    for name in ("mlp", "logistic", "resnet18"):
+    for name in ("mlp", "logistic"):
         assert make_stacked_apply(build_model(name)) is None
+
+
+def test_resnet_forward_parity():
+    """Grouped-stacked ResNet-18 (the north-star model) vs vmap."""
+    model = build_model("resnet18", faithful=False)
+    p0 = model.init(jax.random.key(1), jnp.zeros((1, 32, 32, 3)))["params"]
+    rng = np.random.default_rng(11)
+    stacked = jax.tree.map(
+        lambda v: jnp.asarray(np.stack([
+            np.asarray(v) * (1 + 0.05 * i) for i in range(W)])), p0)
+    x = jnp.asarray(rng.normal(size=(W, 4, 32, 32, 3)).astype(np.float32))
+    s_apply = make_stacked_apply(model)
+    assert s_apply is not None
+    got = jax.jit(s_apply)(stacked, x)
+    want = jax.jit(jax.vmap(
+        lambda p, xx: model.apply({"params": p}, xx)))(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_resnet_update_parity():
+    """One SGD step through the grouped-stacked ResNet matches vmap."""
+    model = build_model("resnet18", faithful=False)
+    p0 = model.init(jax.random.key(1), jnp.zeros((1, 32, 32, 3)))["params"]
+    rng = np.random.default_rng(12)
+    stacked = jax.tree.map(
+        lambda v: jnp.asarray(np.stack([np.asarray(v)] * W)), p0)
+    mom = jax.tree.map(jnp.zeros_like, stacked)
+    bx = jnp.asarray(rng.normal(size=(W, 2, 4, 32, 32, 3)).astype(np.float32))
+    by = jnp.asarray(rng.integers(0, 10, (W, 2, 4)).astype(np.int32))
+    bw = jnp.ones((W, 2, 4), jnp.float32)
+    s_apply = make_stacked_apply(model)
+    kw = dict(lr=0.05, momentum=0.9)
+    f_v = make_stacked_local_update(model.apply, **kw)
+    f_s = make_stacked_local_update(model.apply, **kw, stacked_apply=s_apply)
+    pv, mv, lv, av = jax.jit(f_v)(stacked, mom, bx, by, bw)
+    ps, ms, ls, as_ = jax.jit(f_s)(stacked, mom, bx, by, bw)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4), pv, ps)
+    np.testing.assert_allclose(np.asarray(lv), np.asarray(ls),
+                               rtol=1e-3, atol=1e-4)
 
 
 @pytest.mark.parametrize("algorithm", ["sgd", "fedprox", "fedadmm",
