@@ -97,6 +97,11 @@ ORDERINGS = [
     ("reference-dsgd-circle", ">", "reference-nocons-noniid"),
     ("reference-dsgd-complete-double", ">", "reference-dsgd-circle-double"),
     ("reference-nocons-iid", ">", "reference-nocons-noniid"),
+    # The cell-29 raw-0/1-weights quirk run: unnormalised mixing rows
+    # (sum n−1) blow the consensus up, so it lands far below the
+    # properly-weighted complete graph (reference: 0.32 vs 0.82 on real
+    # MNIST; committed synthetic grid: 0.1021 vs 0.9559).
+    ("reference-dsgd-complete", ">", "reference-dsgd-dynamic"),
 ]
 
 
@@ -128,6 +133,9 @@ def main() -> int:
                          "clobber the committed full-run artifacts)")
     ap.add_argument("--skip-federated", action="store_true")
     ap.add_argument("--skip-gossip", action="store_true")
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="run only these presets (rows merge into the "
+                         "existing summary.json by preset name)")
     args = ap.parse_args()
 
     out = Path(args.out or ("results-smoke" if args.smoke else "results"))
@@ -148,7 +156,15 @@ def main() -> int:
 
     summary = []
     gossip_histories = {}
-    for preset, stem, ref_acc in ([] if args.skip_gossip else GOSSIP_GRID):
+    gossip_grid = [] if args.skip_gossip else GOSSIP_GRID
+    fed_grid = [] if args.skip_federated else FED_GRID
+    if args.only is not None:
+        gossip_grid = [r for r in gossip_grid if r[0] in args.only]
+        fed_grid = [r for r in fed_grid if r[0] in args.only]
+        missing = set(args.only) - {r[0] for r in gossip_grid + fed_grid}
+        if missing:
+            ap.error(f"unknown presets: {sorted(missing)}")
+    for preset, stem, ref_acc in gossip_grid:
         trainer, dt = run_preset(preset, scale=scale, rounds=gossip_rounds)
         csv = out / f"{stem}_{trainer.round}rounds_{trainer.num_workers}users.csv"
         trainer.history.to_csv(csv)
@@ -159,7 +175,9 @@ def main() -> int:
                         "reference_acc": ref_acc, "seconds": round(dt, 2)})
         print(json.dumps(summary[-1]), flush=True)
 
-    if gossip_histories:
+    if gossip_histories and args.only is None:
+        # Partial (--only) reruns skip the grid plot — it would render
+        # only the rerun subset over the committed full-grid image.
         compare_histories(
             gossip_histories,
             metrics=("avg_test_acc", "avg_test_loss", "avg_train_loss"),
@@ -167,9 +185,9 @@ def main() -> int:
             save=out / "gossip_grid_comparison.png",
         )
 
-    if not args.skip_federated:
+    if fed_grid:
         fed_histories = {}
-        for preset, stem, ref_acc in FED_GRID:
+        for preset, stem, ref_acc in fed_grid:
             trainer, dt = run_preset(preset, scale=scale, rounds=fed_rounds)
             csv = out / f"{stem}.csv"
             trainer.history.to_csv(csv)
@@ -179,12 +197,13 @@ def main() -> int:
                             "final_acc": round(float(acc), 4) if acc is not None else None,
                             "reference_acc": ref_acc, "seconds": round(dt, 2)})
             print(json.dumps(summary[-1]), flush=True)
-        compare_histories(
-            fed_histories,
-            metrics=("test_acc", "test_loss", "train_loss"),
-            title="dopt replay of the reference federated trio + SCAFFOLD",
-            save=out / "federated_comparison.png",
-        )
+        if args.only is None:
+            compare_histories(
+                fed_histories,
+                metrics=("test_acc", "test_loss", "train_loss"),
+                title="dopt replay of the reference federated trio + SCAFFOLD",
+                save=out / "federated_comparison.png",
+            )
 
     path = out / "summary.json"
     if path.exists():  # merge partial reruns by preset name
