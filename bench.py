@@ -25,20 +25,25 @@ synthetic at exactly MNIST scale (60,000 train / 10,000 test samples,
 28x28x1) because this environment has no network egress; per-round
 FLOPs and communication volume match the real workload.
 
-What bounds MFU (~16% of bf16 peak on a v5e chip, measured): the round
+What bounds MFU (~21% of bf16 peak on a v5e chip, measured): the round
 is 316 dependent SGD steps (79 steps/epoch x 4 epochs) over a 768-row
-effective batch (6 worker lanes x 128).  Decomposition on hardware:
-the local-step scan is ~95% of the round (per-epoch marginal ~134 ms
-of a ~550 ms round; consensus + dispatch < 10%); quadrupling the batch
-at constant samples does NOT speed it up, so steps are activation-
-bandwidth-bound, not dispatch- or latency-bound — Model1's conv1 has
-1 input channel (no MXU channel contraction to amortise the activation
-traffic) and the faithful conv stack is activation-heavy relative to
-its FLOPs.  Levers tried and rejected: pallas fused SGD update (breaks
-XLA's gradient/update fusion, 1.6x slower), bf16-resident input data
-(layout cost exceeds the bandwidth saving), bf16 param storage (+11%
-throughput but -10pt accuracy).  Eval is evaluated OUTSIDE the
-measured window (it is a metric, not the workload).
+effective batch (6 worker lanes x 128).  Round 4 removed the three
+structural overheads (results/trace_headline.json before/after):
+per-step minibatch gathers — 18% of device time, now ~1% via flat
+[N, F] resident data + slab gathers; select_and_scatter maxpool
+backward — 12%, replaced by a reshape-max whose VJP is an elementwise
+eq-mask; and vmap-over-workers conv lowering — replaced by the grouped
+stacked forward (dopt.models.make_stacked_apply), which is where most
+of the 1.74 -> 2.39 rounds/s came from.  What remains is the conv
+stack itself (~50% of device time): Model1's conv1 has 1 input channel
+(no MXU channel contraction to amortise activation traffic) and the
+faithful 5x5 convs at 28x28 are activation-heavy relative to their
+FLOPs.  Levers tried and rejected: pallas fused SGD update (breaks
+XLA's gradient/update fusion, 1.6x slower), bf16 param storage (+11%
+throughput but -10pt accuracy), carrying grouped-layout kernels
+through the scan (XLA picks worse conv layouts, +6% device time).
+Eval is evaluated OUTSIDE the measured window (it is a metric, not
+the workload).
 
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N, ...}
