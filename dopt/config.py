@@ -94,6 +94,16 @@ class OptimizerConfig:
     # loss term rather than torch-style decoupled decay so FedProx/ADMM
     # gradient edits compose with it identically on both backends).
     rho: float = 0.1   # FedProx proximal weight / FedADMM penalty
+    clip_norm: float = 0.0
+    # Per-worker global-norm gradient clip applied to the final gradient
+    # (after any FedProx/ADMM/SCAFFOLD edit), 0 = off.  Off by default:
+    # the reference has no clipping and the faithful oracle contract
+    # pins its exact update.  The corrected-head (faithful=False) CNNs
+    # need it in bf16 — raw-logit CE on the un-normalised reference
+    # architecture sits at the edge of stability at the reference lr,
+    # and bf16 gradient rounding tips runs across it (measured
+    # run-to-run final-acc scatter 0.3–0.97; clip 1.0 removes it —
+    # results/bench_idiomatic.json).
     fused_update: bool = False  # pallas single-pass momentum-SGD update
     # (dopt.ops.fused_update); numerics identical to the jnp path
 

@@ -98,6 +98,8 @@ class FederatedTrainer:
             cfg.data.dataset, data_dir=cfg.data.data_dir,
             train_size=cfg.data.synthetic_train_size,
             test_size=cfg.data.synthetic_test_size, seed=cfg.seed,
+            input_shape=cfg.model.input_shape,
+            num_classes=cfg.model.num_classes,
         )
         _, self.index_matrix = partition(
             self.dataset.train_y, w, iid=cfg.data.iid,
@@ -184,7 +186,7 @@ class FederatedTrainer:
             algorithm=local_algorithm,
             rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
             update_impl="pallas" if cfg.optim.fused_update else "jnp",
-            stacked_apply=s_apply_f,
+            stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm,
         )
         # Per-epoch big-gather chunking (see gossip.py: per-step gathers
         # carry ~250 µs fixed overhead each on a v5e; slab gathers don't).
@@ -203,7 +205,8 @@ class FederatedTrainer:
                 momentum=cfg.optim.momentum, algorithm=local_algorithm,
                 rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
                 update_impl="pallas" if cfg.optim.fused_update else "jnp",
-                gather_chunks=epoch_chunks, stacked_apply=s_apply_f)
+                gather_chunks=epoch_chunks, stacked_apply=s_apply_f,
+                clip_norm=cfg.optim.clip_norm)
             if self._holdout else None
         )
         if s_apply_f is not None and self.mesh.size > 1:

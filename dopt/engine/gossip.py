@@ -142,6 +142,8 @@ class GossipTrainer:
             cfg.data.dataset, data_dir=cfg.data.data_dir,
             train_size=cfg.data.synthetic_train_size,
             test_size=cfg.data.synthetic_test_size, seed=cfg.seed,
+            input_shape=cfg.model.input_shape,
+            num_classes=cfg.model.num_classes,
         )
         _, self.index_matrix = partition(
             self.dataset.train_y, w, iid=cfg.data.iid,
@@ -253,14 +255,14 @@ class GossipTrainer:
         local = make_stacked_local_update(
             app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
-            stacked_apply=s_apply_f,
+            stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm,
         )
         local_epochs = (
             make_stacked_local_update_epochs(
                 app_f, lr=cfg.optim.lr,
                 momentum=cfg.optim.momentum, algorithm="sgd", l2=l2,
                 update_impl=update_impl, gather_chunks=epoch_chunks,
-                stacked_apply=s_apply_f)
+                stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm)
             if self._holdout else None
         )
         if s_apply_f is not None and self.mesh.size > 1:
@@ -480,6 +482,7 @@ class GossipTrainer:
             app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
             gather_chunks=self._gather_chunks, stacked_apply=s_apply_f,
+            clip_norm=cfg.optim.clip_norm,
         )
         if s_apply_f is not None and self.mesh.size > 1:
             self._local_gather = shard_over_workers(

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from dopt.models.losses import (accuracy, accuracy_stacked, cross_entropy,
                                 cross_entropy_stacked, l2_regulariser,
                                 l2_stacked)
-from dopt.optim import (SGDState, admm_grad_edit, prox_grad_edit,
+from dopt.optim import (SGDState, admm_grad_edit, clip_by_global_norm,
+                        clip_by_global_norm_stacked, prox_grad_edit,
                         scaffold_grad_edit, sgd_step)
 
 
@@ -82,7 +83,7 @@ def _apply_update(p, m, g, *, lr, momentum, update_impl):
 
 
 def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,
-                    update_impl):
+                    update_impl, clip_norm=0.0):
     """One SGD step on concrete batch arrays — the shared body of both
     local-update variants (materialised batches and on-device gather)."""
 
@@ -103,6 +104,8 @@ def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,
             # theta slot carries the server control variate c (broadcast),
             # alpha slot the client control variate c_i (worker-stacked).
             g = scaffold_grad_edit(g, theta, alpha)
+        if clip_norm:
+            g = clip_by_global_norm(g, clip_norm)
         p, m = _apply_update(p, m, g, lr=lr, momentum=momentum,
                              update_impl=update_impl)
         return p, m, loss, accuracy(out, y, w)
@@ -111,7 +114,7 @@ def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,
 
 
 def _make_stacked_step_core(stacked_apply, *, lr, momentum, algorithm, rho,
-                            l2, update_impl):
+                            l2, update_impl, clip_norm=0.0):
     """One SGD step on the FULL [W, B, ...] stacked batch without vmap —
     the grouped-conv fast path (``dopt.models.make_stacked_apply``).
 
@@ -138,6 +141,8 @@ def _make_stacked_step_core(stacked_apply, *, lr, momentum, algorithm, rho,
             g = admm_grad_edit(g, p, theta, alpha, rho)
         elif algorithm == "scaffold":
             g = scaffold_grad_edit(g, theta, alpha)
+        if clip_norm:
+            g = clip_by_global_norm_stacked(g, clip_norm)
         p, m = _apply_update(p, m, g, lr=lr, momentum=momentum,
                              update_impl=update_impl)
         return p, m, lw, accuracy_stacked(out, y, w)
@@ -154,6 +159,7 @@ def make_local_update(
     rho: float = 0.0,
     l2: float = 0.0,
     update_impl: str = "jnp",
+    clip_norm: float = 0.0,
 ):
     """Build the per-worker local-update function.
 
@@ -166,7 +172,7 @@ def make_local_update(
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
-                           update_impl=update_impl)
+                           update_impl=update_impl, clip_norm=clip_norm)
 
     def local_update(params, mom, bx, by, bw, theta=None, alpha=None):
         def step(carry, batch):
@@ -193,7 +199,7 @@ def _arity_wrap(algorithm, fn):
 
 def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
                               rho=0.0, l2=0.0, update_impl="jnp",
-                              stacked_apply=None):
+                              stacked_apply=None, clip_norm=0.0):
     """vmap the per-worker update over the leading worker axis — or,
     with ``stacked_apply`` set (``dopt.models.make_stacked_apply``), run
     the grouped-conv stacked step with NO vmap: the scan iterates over
@@ -204,7 +210,7 @@ def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
-            rho=rho, l2=l2, update_impl=update_impl)
+            rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
 
         def fn(p, m, bx, by, bw, theta=None, alpha=None):
             xs = (bx.swapaxes(0, 1), by.swapaxes(0, 1), bw.swapaxes(0, 1))
@@ -221,7 +227,7 @@ def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
         return _arity_wrap(algorithm, fn)
     fn = make_local_update(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
-                           update_impl=update_impl)
+                           update_impl=update_impl, clip_norm=clip_norm)
     if algorithm == "sgd":
         return jax.vmap(lambda p, m, bx, by, bw: fn(p, m, bx, by, bw))
     if algorithm == "fedprox":
@@ -335,6 +341,7 @@ def make_local_update_gather(
     l2: float = 0.0,
     update_impl: str = "jnp",
     gather_chunks: int | None = None,
+    clip_norm: float = 0.0,
 ):
     """Like ``make_local_update`` but gathers minibatches from the full
     on-device dataset inside the scan: the caller passes the [S, B]
@@ -351,7 +358,7 @@ def make_local_update_gather(
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
-                           update_impl=update_impl)
+                           update_impl=update_impl, clip_norm=clip_norm)
 
     def local_update(params, mom, idx, bw, train_x, train_y,
                      theta=None, alpha=None):
@@ -410,7 +417,7 @@ def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
                                      update_impl="jnp",
                                      gather_chunks=None,
-                                     stacked_apply=None):
+                                     stacked_apply=None, clip_norm=0.0):
     """vmap the gather-variant over the leading worker axis; train arrays
     and theta broadcast, ADMM duals stacked per worker.  With
     ``stacked_apply`` set, the grouped-conv stacked path replaces the
@@ -418,7 +425,7 @@ def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
-            rho=rho, l2=l2, update_impl=update_impl)
+            rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
 
         def fn(p, m, idx, bw, tx, ty, theta=None, alpha=None):
             (p, m), (losses, accs) = _scan_steps_gathered_stacked(
@@ -429,7 +436,8 @@ def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
     fn = make_local_update_gather(apply_fn, lr=lr, momentum=momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
                                   update_impl=update_impl,
-                                  gather_chunks=gather_chunks)
+                                  gather_chunks=gather_chunks,
+                                  clip_norm=clip_norm)
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty: fn(p, m, idx, bw, tx, ty),
@@ -458,6 +466,7 @@ def make_local_update_epochs(
     l2: float = 0.0,
     update_impl: str = "jnp",
     gather_chunks: int | None = None,
+    clip_norm: float = 0.0,
 ):
     """Local update with the reference's EPOCH structure: an outer scan
     over local epochs, each running its steps then evaluating the
@@ -483,7 +492,7 @@ def make_local_update_epochs(
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
-                           update_impl=update_impl)
+                           update_impl=update_impl, clip_norm=clip_norm)
     ev = make_evaluator(apply_fn)
 
     def local_update(params, mom, idx, bw, train_x, train_y, vidx, vw,
@@ -571,7 +580,7 @@ def _stacked_eval_scan(stacked_apply, params, ex, ey, ew):
 def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
                                      update_impl="jnp", gather_chunks=None,
-                                     stacked_apply=None):
+                                     stacked_apply=None, clip_norm=0.0):
     """vmap the epoch-structured update over the leading worker axis;
     train arrays and theta broadcast, per-worker plans / val stacks /
     ADMM duals stacked.  With ``stacked_apply`` set, the grouped-conv
@@ -579,7 +588,7 @@ def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
-            rho=rho, l2=l2, update_impl=update_impl)
+            rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
 
         def fn(p, m, idx, bw, tx, ty, vi, vw_, theta=None, alpha=None):
             vi_s = vi.swapaxes(0, 1)        # [Sv, W, Bv]
@@ -614,7 +623,8 @@ def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
     fn = make_local_update_epochs(apply_fn, lr=lr, momentum=momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
                                   update_impl=update_impl,
-                                  gather_chunks=gather_chunks)
+                                  gather_chunks=gather_chunks,
+                                  clip_norm=clip_norm)
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty, vi, vw_: fn(p, m, idx, bw, tx, ty,

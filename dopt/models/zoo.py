@@ -38,18 +38,55 @@ def _head(x: jnp.ndarray, faithful: bool) -> jnp.ndarray:
     return x
 
 
+@jax.custom_vjp
+def _tiled_max(x6: jnp.ndarray) -> jnp.ndarray:
+    """max over the window axes (2, 4) of a [b, h2, 2, w2, 2, c] tiling
+    with a FIRST-WINNER backward: the gradient goes to the first window
+    element attaining the max in (di, dj) row-major order — torch
+    ``MaxPool2d``'s tie semantics (its backward routes through the
+    argmax index, first occurrence in kernel scan order) — instead of
+    jax's equal split across ties.  Ties are NOT measure-zero in
+    practice: the faithful Model1 conv has no ReLU, so zero-background
+    MNIST pixels produce exact 4-way bias ties in every background
+    window (ADVICE r4)."""
+    return x6.max(axis=(2, 4))
+
+
+def _tiled_max_fwd(x6):
+    m = x6.max(axis=(2, 4))
+    return m, (x6, m)
+
+
+def _tiled_max_bwd(res, g):
+    x6, m = res
+    eq = x6 == m[:, :, None, :, None, :]
+    # torch scan order: linear window index l = di*2 + dj; the winner is
+    # the tied element with the smallest l.  Everything stays
+    # elementwise + two tiny strided reductions — no select_and_scatter,
+    # no relayout.
+    l = (jax.lax.broadcasted_iota(jnp.int32, x6.shape, 2) * 2
+         + jax.lax.broadcasted_iota(jnp.int32, x6.shape, 4))
+    lmin = jnp.min(jnp.where(eq, l, 4), axis=(2, 4), keepdims=True)
+    mask = eq & (l == lmin)
+    return (g[:, :, None, :, None, :] * mask.astype(g.dtype),)
+
+
+_tiled_max.defvjp(_tiled_max_fwd, _tiled_max_bwd)
+
+
 def _max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
-    """2×2 stride-2 max pool via reshape + reduce_max.
+    """2×2 stride-2 max pool via reshape + tiled reduce_max.
 
     Forward-identical to ``nn.max_pool(x, (2, 2), strides=(2, 2))`` for
     even H/W (the windows are non-overlapping, so the reshape tiles them
-    exactly), but its VJP lowers to an elementwise equality-mask instead
-    of XLA's ``select_and_scatter`` — which the reduce_window backward
-    otherwise costs us ~12% of device time on the Model1 training step
-    (results/trace_headline.json).  Tie handling differs in theory
-    (gradient splits equally across tied window elements rather than
-    picking the first winner); on float conv activations ties are
-    measure-zero and the oracle parity suite stays green.
+    exactly), but its VJP lowers to an elementwise first-winner mask
+    instead of XLA's ``select_and_scatter`` — which the reduce_window
+    backward otherwise costs us ~12% of device time on the Model1
+    training step (results/trace_headline.json).  The custom VJP
+    (``_tiled_max``) routes tie gradients to the FIRST window element in
+    torch's kernel scan order, bit-matching MaxPool2d's backward even on
+    real data with exact ties (e.g. zero-background MNIST under the
+    no-ReLU faithful conv) — not jax's default equal split.
 
     Odd spatial dims fall back to ``nn.max_pool`` (which floors), since
     the reshape tiling requires even H/W.
@@ -57,7 +94,7 @@ def _max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
     b, h, w, c = x.shape
     if h % 2 or w % 2:
         return nn.max_pool(x, (2, 2), strides=(2, 2))
-    return x.reshape(b, h // 2, 2, w // 2, 2, c).max(axis=(2, 4))
+    return _tiled_max(x.reshape(b, h // 2, 2, w // 2, 2, c))
 
 
 class _ReferenceCNN(nn.Module):
@@ -92,7 +129,16 @@ class _ReferenceCNN(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
         x = nn.relu(x)
-        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        # Corrected head: compute the logits layer in f32 (standard
+        # mixed-precision practice — the raw-logit objective is
+        # sensitive to bf16 rounding of the logit gradients, measured
+        # run-to-run final-acc scatter 0.3-0.96 vs a tight band with the
+        # f32 head; ~5k MACs/sample, free).  Faithful mode keeps the
+        # compute dtype end-to-end: its double-softmax objective is
+        # insensitive (softmax squashing) and the oracle parity
+        # contract pins its op sequence.
+        head_dtype = self.dtype if self.faithful else jnp.float32
+        x = nn.Dense(self.num_classes, dtype=head_dtype, name="fc2")(x)
         return _head(x, self.faithful)
 
 
@@ -403,10 +449,16 @@ def _make_stacked_cnn_apply(model: "_ReferenceCNN"):
     """
     faithful, dtype = model.faithful, model.dtype
 
-    def to_fast(p):
+    def to_fast(p, hp, wp):
+        """hp/wp: the post-pool spatial dims, taken from the ACTUAL
+        activation shape at the fc1 call site (not inferred by a square
+        root — non-square inputs reshape correctly, ADVICE r4)."""
         c2n = p["conv2"]["kernel"].shape[-1]
         f1 = p["fc1"]["kernel"]           # [W, H'·Wd'·C2, hidden]
-        hw = int(round((f1.shape[1] // c2n) ** 0.5))
+        if f1.shape[1] != hp * wp * c2n:
+            raise ValueError(
+                f"fc1 kernel fan-in {f1.shape[1]} != post-pool "
+                f"H'·Wd'·C2 = {hp}·{wp}·{c2n}")
         f2 = p["fc2"]["kernel"]           # [W, hidden, ncls]
         return {
             "conv1": {"kernel": _to_grouped_kernel(p["conv1"]["kernel"]),
@@ -414,7 +466,7 @@ def _make_stacked_cnn_apply(model: "_ReferenceCNN"):
             "conv2": {"kernel": _to_grouped_kernel(p["conv2"]["kernel"]),
                       "bias": p["conv2"]["bias"]},
             "fc1": {"kernel": _to_grouped_kernel(
-                        f1.reshape(f1.shape[0], hw, hw, c2n, f1.shape[2])),
+                        f1.reshape(f1.shape[0], hp, wp, c2n, f1.shape[2])),
                     "bias": p["fc1"]["bias"]},
             "fc2": {"kernel": _to_grouped_kernel(
                         f2.reshape(f2.shape[0], 1, 1, *f2.shape[1:])),
@@ -422,8 +474,12 @@ def _make_stacked_cnn_apply(model: "_ReferenceCNN"):
         }
 
     def apply(params, x):
-        fp = to_fast(params)
         w, b = x.shape[0], x.shape[1]
+        h_in, w_in = x.shape[2], x.shape[3]
+        # Post-pool spatial dims after two stride-2 pools (floored —
+        # nn.max_pool's odd-dim behaviour).
+        hp, wp = h_in // 2 // 2, w_in // 2 // 2
+        fp = to_fast(params, hp, wp)
         # [W, B, H, Wd, C] → [B, H, Wd, W·C] (worker-major channels)
         z = jnp.moveaxis(x.astype(dtype), 0, 3)
         z = z.reshape(*z.shape[:3], -1)
@@ -440,8 +496,12 @@ def _make_stacked_cnn_apply(model: "_ReferenceCNN"):
         z = _conv_fast(z, fp["fc1"]["kernel"], w, dtype=dtype,
                        padding="VALID", bias=fp["fc1"]["bias"])
         z = nn.relu(z)
-        z = _conv_fast(z, fp["fc2"]["kernel"], w, dtype=dtype,
-                       padding="VALID", bias=fp["fc2"]["bias"])
+        # f32 logits layer on the corrected head — mirrors the flax
+        # module (see _ReferenceCNN.__call__).
+        head_dtype = dtype if faithful else jnp.float32
+        z = _conv_fast(z.astype(head_dtype), fp["fc2"]["kernel"], w,
+                       dtype=head_dtype, padding="VALID",
+                       bias=fp["fc2"]["bias"])
         ncls = z.shape[-1] // w
         z = z.reshape(b, w, ncls)
         z = jnp.moveaxis(z, 1, 0)                 # [W, B, ncls]

@@ -45,6 +45,32 @@ def sgd_step(params, state: SGDState, grads, *, lr: float, momentum: float):
     return new_params, SGDState(momentum=new_buf)
 
 
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale ``grads`` so their global ℓ2 norm is at most ``max_norm``
+    (torch.nn.utils.clip_grad_norm_ semantics).  Norm accumulates in
+    f32 regardless of the leaf dtype."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree.leaves(grads))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+def clip_by_global_norm_stacked(grads, max_norm: float):
+    """Per-worker ``clip_by_global_norm`` over a [W, ...]-stacked pytree:
+    each worker's gradient is clipped by its OWN global norm — identical
+    to vmapping the per-worker clip."""
+    sq = 0.0
+    for g in jax.tree.leaves(grads):
+        sq = sq + jnp.sum(
+            jnp.square(g.astype(jnp.float32)).reshape(g.shape[0], -1), axis=1)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
+
+    def app(g):
+        return g * scale.reshape((-1,) + (1,) * (g.ndim - 1)).astype(g.dtype)
+
+    return jax.tree.map(app, grads)
+
+
 def prox_grad_edit(grads, params, theta, rho: float):
     """FedProx: g + rho*(p - theta)  (reference clients.py:111)."""
     return jax.tree.map(lambda g, p, t: g + rho * (p - t), grads, params, theta)

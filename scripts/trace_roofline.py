@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import glob
 import json
 import sys
 import tempfile
@@ -53,54 +52,11 @@ def build_trainer(preset: str):
 
 
 def summarize_xplane(trace_dir: str) -> dict:
-    """Reduce the captured xplane to category/op-level self times."""
-    from xprof.convert import raw_to_tool_data
+    """Reduce the captured xplane to category/op-level self times
+    (shared reduction: ``dopt.utils.profiling.xplane_op_stats``)."""
+    from dopt.utils.profiling import xplane_op_stats
 
-    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    data, _ = raw_to_tool_data.xspace_to_tool_data(paths,
-                                                   "framework_op_stats", {})
-    table = json.loads(data if isinstance(data, str) else data.decode())
-    if isinstance(table, list):
-        table = table[0]
-    cols = [c["id"] for c in table["cols"]]
-    idx = {c: i for i, c in enumerate(cols)}
-
-    def val(row, col):
-        cell = row["c"][idx[col]]
-        return None if cell is None else cell.get("v")
-
-    by_cat: dict[str, float] = {}
-    device_total = host_total = 0.0
-    ops = []
-    for row in table.get("rows", []):
-        side = val(row, "host_or_device")
-        self_us = float(val(row, "total_self_time") or 0.0)
-        cat = val(row, "type") or "?"
-        if side == "Device":
-            device_total += self_us
-            by_cat[cat] = by_cat.get(cat, 0.0) + self_us
-            ops.append({
-                "op_type": cat,
-                "operation": val(row, "operation"),
-                "occurrences": val(row, "occurrences"),
-                "total_self_time_us": round(self_us, 1),
-            })
-        else:
-            host_total += self_us
-    ops.sort(key=lambda o: -o["total_self_time_us"])
-    cat_rows = sorted(by_cat.items(), key=lambda kv: -kv[1])
-    return {
-        "device_self_time_us": round(device_total, 1),
-        "host_self_time_us": round(host_total, 1),
-        "device_categories": [
-            {"op_type": k, "self_time_us": round(v, 1),
-             "pct_of_device": round(100.0 * v / max(device_total, 1e-9), 2)}
-            for k, v in cat_rows
-        ],
-        "top_device_ops": ops[:20],
-    }
+    return xplane_op_stats(trace_dir)
 
 
 def main() -> int:

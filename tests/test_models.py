@@ -121,7 +121,10 @@ def test_bf16_compute_mode_trains():
     # params stay fp32 (bf16 is compute-only)
     assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
     out = m.apply({"params": params}, jnp.ones((2, 28, 28, 1)))
-    assert out.dtype == jnp.bfloat16 and out.shape == (2, 10)
+    # Corrected head: the logits layer computes in f32 even under bf16
+    # compute (raw-logit CE is bf16-noise-sensitive; see zoo.py), so
+    # the output dtype is float32.
+    assert out.dtype == jnp.float32 and out.shape == (2, 10)
 
     import dataclasses
 
@@ -144,3 +147,51 @@ def test_bf16_compute_mode_trains():
     h = tr.run(rounds=4, block=2)
     accs = [r["avg_test_acc"] for r in h.rows if "avg_test_acc" in r]
     assert accs[-1] > 0.6, accs
+
+
+def test_max_pool_first_winner_tie_gradients_match_torch():
+    """The reshape-max pool's custom VJP must route tie gradients to the
+    FIRST window element in kernel scan order, exactly like torch's
+    MaxPool2d backward — ties are common on real data (zero-background
+    MNIST under the faithful no-ReLU conv gives exact 4-way bias ties
+    in every background window, ADVICE r4)."""
+    torch = pytest.importorskip("torch")
+
+    from dopt.models.zoo import _max_pool_2x2
+
+    rng = np.random.default_rng(0)
+    # Quantised values force plenty of exact ties, including all-equal
+    # windows; a zero block models MNIST background.
+    x = rng.integers(-2, 3, size=(2, 8, 8, 3)).astype(np.float32)
+    x[0, :4, :4, :] = 0.0
+    # Weighted sum output so the upstream gradient is non-uniform.
+    gw = rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+
+    gj = jax.grad(
+        lambda a: jnp.sum(_max_pool_2x2(a) * gw))(jnp.asarray(x))
+
+    xt = torch.tensor(np.moveaxis(x, -1, 1), requires_grad=True)  # NCHW
+    out = torch.nn.functional.max_pool2d(xt, 2, 2)
+    out.backward(torch.tensor(np.moveaxis(gw, -1, 1)))
+    gt = np.moveaxis(xt.grad.numpy(), 1, -1)
+
+    np.testing.assert_array_equal(np.asarray(gj), gt)
+
+
+def test_stacked_cnn_apply_non_square_input():
+    """The grouped-stacked CNN forward must handle non-square inputs
+    (fc1's VALID-conv kernel reshape derives H'/W' from the activation
+    shape, not a square-root guess — ADVICE r4)."""
+    from dopt.models import make_stacked_apply
+
+    m = build_model("model1", faithful=False)
+    shape = (12, 8, 1)
+    p1 = _init(m, shape)
+    stacked = jax.tree.map(lambda a: jnp.stack([a, a]), p1)
+    x = jnp.asarray(np.random.default_rng(1).normal(
+        size=(2, 3, *shape)), jnp.float32)
+    out = make_stacked_apply(m)(stacked, x)
+    assert out.shape == (2, 3, 10)
+    ref = m.apply({"params": p1}, x[0])
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
