@@ -57,6 +57,11 @@ class ModelConfig:
     """Model zoo selection (reference ``args.model`` string dispatch)."""
 
     model: str = "model1"    # model1 | model3 | mlp | resnet18 | logistic
+    stage_sizes: tuple[int, ...] | None = None
+    # resnet18 only: residual blocks per stage (None = the standard
+    # (2, 2, 2, 2)).  Smaller values give shallow variants for tests
+    # and the multichip dryrun, where a full-depth compile on one CPU
+    # core would blow the time budget.
     faithful: bool = True
     # faithful=True reproduces the reference's Softmax-head +
     # CrossEntropyLoss double-softmax (models.py:22-27 + clients.py:11);
@@ -146,6 +151,16 @@ class GossipConfig:
     local_ep: int = 4
     local_bs: int = 128
     eps: int = 1                # consensus sweeps per round (FedLCon)
+    eval_mode: str = "full"     # full | sharded
+    # How the per-round fleet eval reads the test set.  'full' is the
+    # reference's semantics (EVERY client evaluates the ENTIRE test
+    # split, P2 clients.py:71-86) — W·|test| sample-forwards per eval,
+    # which on baseline5 costs more device time than the training round
+    # itself (3.1 of 5.5 s/round measured).  'sharded' gives each
+    # worker a round-robin 1/W shard: the fleet-MEAN metric is an
+    # unbiased estimate from |test| total forwards, per-worker rows are
+    # ~W× noisier.  Throughput trims use 'sharded'; parity runs keep
+    # 'full'.
     comm_impl: str = "auto"     # consensus collective: auto | dense | shift
     # 'dense'  — all_gather + contraction with the [n, n] mixing matrix
     #            (right for complete/random/arbitrary graphs).

@@ -1,7 +1,8 @@
 from dopt.data.datasets import Dataset, load_dataset
 from dopt.data.partition import holdout_split, iid_split, noniid_split, partition
 from dopt.data.pipeline import (BatchPlan, eval_batches, make_batch_plan,
-                                gather_batches, stacked_eval_batches)
+                                gather_batches, sharded_eval_batches,
+                                stacked_eval_batches)
 
 __all__ = [
     "Dataset",
@@ -14,5 +15,6 @@ __all__ = [
     "eval_batches",
     "make_batch_plan",
     "gather_batches",
+    "sharded_eval_batches",
     "stacked_eval_batches",
 ]

@@ -132,6 +132,7 @@ class FederatedTrainer:
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
             faithful=cfg.model.faithful, dtype=cfg.model.compute_dtype,
+            stage_sizes=cfg.model.stage_sizes,
         )
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))

@@ -31,8 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dopt.config import ExperimentConfig
-from dopt.data import eval_batches, load_dataset, make_batch_plan, partition
-from dopt.engine.local import (flat_input_apply, flat_input_stacked_apply,
+from dopt.data import (eval_batches, load_dataset, make_batch_plan,
+                       partition, sharded_eval_batches)
+from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
+                               flat_input_stacked_apply, make_evaluator,
                                make_stacked_evaluator, make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                make_stacked_local_update_gather,
@@ -111,6 +113,9 @@ class GossipTrainer:
                 f"unknown gossip algorithm {g.algorithm!r}; one of "
                 "dsgd|nocons|centralized|fedlcon|gossip|choco"
             )
+        if g.eval_mode not in ("full", "sharded"):
+            raise ValueError(f"unknown eval_mode {g.eval_mode!r}; "
+                             "one of full|sharded")
         _reject_sequence_model(cfg)
         validate_optimizer(cfg)
         if g.algorithm == "centralized":
@@ -163,15 +168,33 @@ class GossipTrainer:
         ntr = self.dataset.train_x.shape[0]
         self._train_x = jnp.asarray(self.dataset.train_x.reshape(ntr, -1))
         self._train_y = jnp.asarray(self.dataset.train_y)
-        ex, ey, ew = eval_batches(self.dataset.test_x, self.dataset.test_y,
-                                  batch_size=max(g.local_bs, 256))
-        self._eval = (jnp.asarray(ex), jnp.asarray(ey), jnp.asarray(ew))
+        if g.eval_mode == "sharded":
+            # Per-worker round-robin test shards ([W, S, B] stacks of
+            # FLAT feature rows): the fleet-mean metric costs |test|
+            # sample-forwards per eval instead of W·|test| (the full
+            # mode's per-round eval exceeded the baseline5 training
+            # round itself — see GossipConfig.eval_mode).
+            tn = len(self.dataset.test_y)
+            si, sw = sharded_eval_batches(tn, w,
+                                          batch_size=max(g.local_bs, 256))
+            test_flat = self.dataset.test_x.reshape(tn, -1)
+            self._eval = (jnp.asarray(test_flat[si]),
+                          jnp.asarray(self.dataset.test_y[si]),
+                          jnp.asarray(sw))
+            self._eval_full = None     # built lazily by evaluate()
+        else:
+            ex, ey, ew = eval_batches(self.dataset.test_x,
+                                      self.dataset.test_y,
+                                      batch_size=max(g.local_bs, 256))
+            self._eval = (jnp.asarray(ex), jnp.asarray(ey), jnp.asarray(ew))
+            self._eval_full = self._eval
 
         # Model + stacked state (every worker starts from the same init —
         # the reference deepcopies one global model, simulators.py:23-24).
         self.model = build_model(
             cfg.model.model, num_classes=cfg.model.num_classes,
             faithful=cfg.model.faithful, dtype=cfg.model.compute_dtype,
+            stage_sizes=cfg.model.stage_sizes,
         )
         key = jax.random.key(cfg.seed)
         dummy = jnp.zeros((1, *cfg.model.input_shape))
@@ -277,10 +300,28 @@ class GossipTrainer:
                     local_epochs, self.mesh, "wwwwrrww", "www")
         use_holdout = self._holdout
         local_ep_n = g.local_ep
-        evaluator = make_stacked_evaluator(self.model.apply,
-                                           stacked_apply=s_apply)
+        full_evaluator = make_stacked_evaluator(self.model.apply,
+                                                stacked_apply=s_apply)
         if s_apply is not None and self.mesh.size > 1:
-            evaluator = shard_over_workers(evaluator, self.mesh, "wrrr", "w")
+            full_evaluator = shard_over_workers(full_evaluator, self.mesh,
+                                                "wrrr", "w")
+        if g.eval_mode == "sharded":
+            # Per-worker-data eval over [W, S, B] flat-row stacks — the
+            # same [W]-dict contract as the full evaluator, so the round
+            # and block programs are mode-agnostic.
+            if s_apply_f is not None:
+                def evaluator(p, ex, ey, ew):
+                    return _stacked_eval_scan(
+                        s_apply_f, p, ex.swapaxes(0, 1), ey.swapaxes(0, 1),
+                        ew.swapaxes(0, 1))
+                if self.mesh.size > 1:
+                    evaluator = shard_over_workers(evaluator, self.mesh,
+                                                   "wwww", "w")
+            else:
+                evaluator = jax.vmap(make_evaluator(app_f))
+        else:
+            evaluator = full_evaluator
+        self._full_evaluator = full_evaluator
         eps = 1 if (g.algorithm != "fedlcon" or g.faithful_bugs) else g.eps
         do_mix = g.algorithm in ("dsgd", "fedlcon", "gossip")
         is_choco = g.algorithm == "choco"
@@ -751,5 +792,15 @@ class GossipTrainer:
     # Convenience: per-worker eval of the current state (reuses the
     # round step's evaluator — same wrapping, same jit cache).
     def evaluate(self) -> dict[str, np.ndarray]:
-        out = jax.jit(self._evaluator)(self.params, *self._eval)
+        """Reference-semantics eval: EVERY worker on the FULL test set,
+        regardless of ``eval_mode`` (the sharded mode only changes the
+        in-training per-round metric)."""
+        if self._eval_full is None:
+            ex, ey, ew = eval_batches(self.dataset.test_x,
+                                      self.dataset.test_y,
+                                      batch_size=max(self.cfg.gossip.local_bs,
+                                                     256))
+            self._eval_full = (jnp.asarray(ex), jnp.asarray(ey),
+                               jnp.asarray(ew))
+        out = jax.jit(self._full_evaluator)(self.params, *self._eval_full)
         return {k: np.asarray(v) for k, v in out.items()}

@@ -566,6 +566,7 @@ def build_model(
     num_classes: int = 10,
     faithful: bool | None = None,
     dtype: Any = jnp.float32,
+    stage_sizes: Sequence[int] | None = None,
 ) -> nn.Module:
     """Model dispatch by name — the typed replacement for the reference's
     if/elif on ``args.model`` (``servers.py:33-40``, ``simulators.py:31-38``).
@@ -575,6 +576,8 @@ def build_model(
     to), False for mlp/logistic/resnet18 (new models, corrected head).
     ``dtype`` may be a string ("bfloat16" → MXU-native compute); params
     stay float32 (flax param_dtype default) — bf16 is compute-only.
+    ``stage_sizes`` (resnet18 only) overrides the per-stage block counts
+    for shallow variants.
     """
     if isinstance(dtype, str):
         dtype = jnp.dtype(dtype)
@@ -584,6 +587,10 @@ def build_model(
     kwargs: dict[str, Any] = dict(num_classes=num_classes, dtype=dtype)
     if faithful is not None:
         kwargs["faithful"] = faithful
+    if stage_sizes is not None:
+        if key != "resnet18":
+            raise ValueError("stage_sizes applies to resnet18 only")
+        kwargs["stage_sizes"] = tuple(stage_sizes)
     return _ZOO[key](**kwargs)
 
 

@@ -24,6 +24,15 @@ from dopt.config import (DataConfig, ExperimentConfig, FederatedConfig,
 MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
 CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
 
+# Per-preset throughput-trim compute dtype, chosen by CONTROLLED dtype
+# experiment (results/time_to_target.json dtype_control), not by
+# assumption: baseline2's corrected-head CNN pays a ~2.7x per-round
+# convergence tax in bf16 (0.355 vs 0.664 acc at round 10, identical
+# init/batches) that swamps bf16's 1.5x step-time win, so its trim is
+# float32; baseline5's GroupNorm ResNet shows no such tax and keeps
+# bfloat16.  Presets not listed default to bfloat16.
+TRIM_COMPUTE_DTYPE = {"baseline2": "float32", "baseline5": "bfloat16"}
+
 
 def _mnist_data(num_users: int, iid: bool, shards: int = 2,
                 **kw) -> DataConfig:

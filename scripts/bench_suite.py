@@ -173,10 +173,20 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
     cfg = get_preset(name)
     # Throughput trim: bf16 compute + native host planner.  Same
     # algorithm, topology, data partition, and round structure.
+    from dopt.presets import TRIM_COMPUTE_DTYPE
+
     cfg = cfg.replace(
-        model=dataclasses.replace(cfg.model, compute_dtype="bfloat16"),
+        model=dataclasses.replace(
+            cfg.model,
+            compute_dtype=TRIM_COMPUTE_DTYPE.get(name, "bfloat16")),
         data=dataclasses.replace(cfg.data, plan_impl="native"),
     )
+    if cfg.gossip is not None:
+        # Sharded per-round eval (see GossipConfig.eval_mode): the
+        # measured window carries the per-round metric without paying
+        # W·|test| sample-forwards for it.
+        cfg = cfg.replace(gossip=dataclasses.replace(
+            cfg.gossip, eval_mode="sharded"))
     is_gossip = cfg.gossip is not None
     g = cfg.gossip if is_gossip else cfg.federated
     # Tiny models (baseline4's 248-param logistic) get a long fused
@@ -243,7 +253,7 @@ def measure_preset(name: str, *, quick: bool, skip_oracle: bool) -> dict:
         "tpu_rounds_per_sec": round(rps, 4),
         "tpu_samples_per_sec": round(sps, 1),
         "device_kind": kind,
-        "compute_dtype": "bfloat16",
+        "compute_dtype": cfg.model.compute_dtype,
         # Measured-window phase attribution (PhaseTimers): round_step is
         # the blocking device time of the fused scan dispatch,
         # host_batch_plan the host-side planning.
@@ -328,13 +338,20 @@ def main() -> int:
 
     import jax
 
+    out = Path(args.out)
+    if args.only and out.exists():
+        # Partial regeneration: replace only the re-run presets, keep
+        # the rest (their oracle columns are expensive to recompute).
+        old_rows = json.loads(out.read_text())["results"]
+        fresh = {r["preset"]: r for r in results}
+        results = [fresh.pop(r["preset"], r) for r in old_rows]
+        results += list(fresh.values())
     payload = {
         "suite": "dopt bench_suite",
         "device": str(jax.devices()[0]),
         "quick": args.quick,
         "results": results,
     }
-    out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(payload, indent=2) + "\n")
     print(f"wrote {out}")
