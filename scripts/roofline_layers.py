@@ -1,0 +1,218 @@
+"""Per-layer grouped-conv roofline for baseline5 (VERDICT r4 item 5).
+
+For every distinct conv shape in the grouped-stacked ResNet-18 fleet
+program (32 workers as feature_group_count=32), measures achieved
+training TFLOP/s (fwd + bwd, 3x fwd accounting matched by actual
+autodiff work) two ways on the real chip:
+
+* grouped   — the fleet execution: x [B, H, W, 32*Cin], kernel
+              [kh, kw, Cin, 32*Cout], feature_group_count=32.
+* single    — the fleet-INDEPENDENCE bound term: one weight set at the
+              same total sample count: x [32*B, H, W, Cin] (groups=1).
+
+The ratio column shows exactly which layers pay a grouped-conv penalty
+and which hit the same hardware ceiling either way — the committed
+evidence behind roofline_baseline5.json's measured_fraction_of_bound.
+Also probes the two worst layers with lane-batch 128 (local_bs
+128/lane, VERDICT's suggested recovery lever).
+
+Writes results/roofline_layers_baseline5.json.
+Usage: python scripts/roofline_layers.py [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+W = 32          # workers (feature groups)
+B = 64          # per-lane batch (baseline5 local_bs)
+
+# (name, count, H, Cin, Cout, kh, stride) — input spatial is HxH; the
+# ResNet-18 stage structure from dopt.models.zoo.ResNet18 at 32x32
+# CIFAR inputs (stage_sizes (2,2,2,2); count = how many convs of this
+# exact shape the model runs per forward).
+LAYERS = [
+    ("stem",        1, 32,   3,  64, 3, 1),
+    ("s0.conv",     4, 32,  64,  64, 3, 1),
+    ("s1.down",     1, 32,  64, 128, 3, 2),
+    ("s1.conv",     3, 16, 128, 128, 3, 1),
+    ("s1.proj",     1, 32,  64, 128, 1, 2),
+    ("s2.down",     1, 16, 128, 256, 3, 2),
+    ("s2.conv",     3,  8, 256, 256, 3, 1),
+    ("s2.proj",     1, 16, 128, 256, 1, 2),
+    ("s3.down",     1,  8, 256, 512, 3, 2),
+    ("s3.conv",     3,  4, 512, 512, 3, 1),
+    ("s3.proj",     1,  8, 256, 512, 1, 2),
+]
+
+
+def conv_flops(h, cin, cout, k, stride, batch, groups):
+    ho = h // stride
+    macs = batch * ho * ho * cout * k * k * cin * groups
+    return 2 * macs          # fwd FLOPs; training = 3x (fwd+bwd)
+
+
+def measure(fn, args, iters):
+    """Per-iteration time of fwd + dK + dX (the full 3x-fwd training
+    cost the table's FLOP accounting assumes), measured as ONE jitted
+    ``lax.scan`` of ``iters`` DEPENDENT steps — each step feeds its
+    gradients back into the next step's inputs, so no iteration can be
+    elided, reordered, or overlapped (a naive dispatch loop over a
+    remote-tunnel device measured impossible >10 PFLOP/s)."""
+    import jax
+
+    def run_impl(k, x, ct):
+        # ct enters as a jit ARGUMENT (a closure constant this large
+        # blows the remote-compile request-size limit).
+        def body(carry, _):
+            k_, x_ = carry
+            dk, dx = jax.grad(fn, argnums=(0, 1))(k_, x_, ct)
+            return (k_ + 1e-4 * dk, x_ + 1e-4 * dx), ()
+
+        return jax.lax.scan(body, (k, x), None, length=iters)[0]
+
+    run = jax.jit(run_impl)
+    r = run(*args)
+    jax.block_until_ready(r)
+    # Wall-clock is NOT trustworthy on this tunneled device for
+    # sub-second intervals (block_until_ready returns early; a naive
+    # loop measured >40 PFLOP/s on a 197 TF/s chip).  The profiler's
+    # device self-time is repeatable to ~0.01% and is the basis here.
+    from dopt.utils.profiling import device_time_of
+
+    def blk():
+        jax.block_until_ready(run(*args))
+
+    return device_time_of(blk) / 1e6 / iters
+
+
+def bench_layer(h, cin, cout, k, stride, *, lane_batch=B, iters=30):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    ho = h // stride
+    kern_g = jnp.asarray(rng.normal(size=(k, k, cin, W * cout)) * 0.05,
+                         jnp.bfloat16)
+    x_g = jnp.asarray(rng.normal(size=(lane_batch, h, h, W * cin)),
+                      jnp.bfloat16)
+    # Random fixed cotangent: with a plain sum loss the cotangent is
+    # all-ones and XLA legally simplifies BOTH backward convolutions to
+    # cheap reductions (measured >chip-peak "TFLOP/s"); a random c
+    # keeps dX and dK honest full convolutions.
+    c_g = jnp.asarray(rng.normal(size=(lane_batch, ho, ho, W * cout)),
+                      jnp.bfloat16)
+
+    def f_grouped(kern, x, ct):
+        out = jax.lax.conv_general_dilated(
+            x, kern, (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=W)
+        return jnp.sum((out * ct).astype(jnp.float32))
+
+    kern_s = jnp.asarray(rng.normal(size=(k, k, cin, cout)) * 0.05,
+                         jnp.bfloat16)
+    x_s = jnp.asarray(rng.normal(size=(W * lane_batch, h, h, cin)),
+                      jnp.bfloat16)
+    c_s = jnp.asarray(rng.normal(size=(W * lane_batch, ho, ho, cout)),
+                      jnp.bfloat16)
+
+    def f_single(kern, x, ct):
+        out = jax.lax.conv_general_dilated(
+            x, kern, (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.sum((out * ct).astype(jnp.float32))
+
+    t_g = measure(f_grouped, (kern_g, x_g, c_g), iters)
+    t_s = measure(f_single, (kern_s, x_s, c_s), iters)
+    fl = 3 * conv_flops(h, cin, cout, k, stride, lane_batch, W)
+    return fl, fl / t_g / 1e12, fl / t_s / 1e12
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--out",
+                    default="results/roofline_layers_baseline5.json")
+    args = ap.parse_args()
+
+    import jax
+
+    from dopt.utils.profiling import device_peak_flops
+
+    kind, peak = device_peak_flops()
+    rows = []
+    for name, count, h, cin, cout, k, stride in LAYERS:
+        fl, tf_g, tf_s = bench_layer(h, cin, cout, k, stride,
+                                     iters=args.iters)
+        rows.append({
+            "layer": name, "count": count, "spatial": h,
+            "cin": cin, "cout": cout, "kernel": k, "stride": stride,
+            "train_flops_fleet": fl,
+            "grouped_tflops": round(tf_g, 2),
+            "single_tflops": round(tf_s, 2),
+            "grouped_over_single": round(tf_g / tf_s, 3),
+            "grouped_mfu": round(tf_g * 1e12 / peak, 4) if peak else None,
+        })
+        print(f"{name:10s} {h:3}px {cin:4}->{cout:<4} k{k} s{stride}: "
+              f"grouped {tf_g:6.1f} TF/s, single {tf_s:6.1f} TF/s "
+              f"(ratio {tf_g/tf_s:.2f})", flush=True)
+
+    # Weighted fleet summary: time-weighted by per-layer grouped cost.
+    tot_fl = sum(r["train_flops_fleet"] * r["count"] for r in rows)
+    tot_tg = sum(r["train_flops_fleet"] * r["count"]
+                 / (r["grouped_tflops"] * 1e12) for r in rows)
+    tot_ts = sum(r["train_flops_fleet"] * r["count"]
+                 / (r["single_tflops"] * 1e12) for r in rows)
+    summary = {
+        "conv_stack_grouped_tflops": round(tot_fl / tot_tg / 1e12, 2),
+        "conv_stack_single_tflops": round(tot_fl / tot_ts / 1e12, 2),
+        "conv_stack_grouped_fraction_of_single": round(tot_ts / tot_tg, 3),
+    }
+    print("conv stack:", summary, flush=True)
+
+    # Recovery probe: the two worst ratio layers at lane batch 128
+    # (VERDICT's local_bs-128 lever).
+    worst = sorted(rows, key=lambda r: r["grouped_over_single"])[:2]
+    probes = []
+    for r in worst:
+        fl, tf_g, tf_s = bench_layer(
+            r["spatial"], r["cin"], r["cout"], r["kernel"], r["stride"],
+            lane_batch=128, iters=args.iters)
+        probes.append({"layer": r["layer"], "lane_batch": 128,
+                       "grouped_tflops": round(tf_g, 2),
+                       "single_tflops": round(tf_s, 2),
+                       "grouped_over_single": round(tf_g / tf_s, 3)})
+        print(f"probe {r['layer']} @ lane_batch=128: grouped {tf_g:.1f} "
+              f"single {tf_s:.1f} (ratio {tf_g/tf_s:.2f})", flush=True)
+
+    payload = {
+        "suite": "roofline_layers_baseline5",
+        "device": str(jax.devices()[0]),
+        "device_kind": kind,
+        "bf16_peak_tflops": peak / 1e12 if peak else None,
+        "workers": W, "lane_batch": B,
+        "note": ("fwd+bwd (autodiff wrt kernel) achieved TFLOP/s per "
+                 "distinct conv shape; 'single' = one weight set at the "
+                 "same total sample count (the fleet-independence bound "
+                 "term)."),
+        "layers": rows,
+        "summary": summary,
+        "lane_batch_128_probe": probes,
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(payload, indent=2) + "\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
