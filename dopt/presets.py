@@ -161,9 +161,18 @@ def baseline_5_gossip32_resnet() -> ExperimentConfig:
         model=ModelConfig(model="resnet18", faithful=False,
                           input_shape=(32, 32, 3)),
         optim=OptimizerConfig(lr=0.1, momentum=0.9),
+        # local_bs 128 (not 64): the per-layer roofline showed the
+        # grouped-conv fleet program is LANE-BATCH-STARVED at 64 rows —
+        # stride-2 / 1x1 / deep-stage convs run at ~0.35x of their
+        # single-weight-set rate, recovering to ~0.9x at 128
+        # (results/roofline_layers_baseline5.json).  Same samples per
+        # round (one epoch over the shard), 23% less device time per
+        # round, and measurably better convergence (monotone to 1.0 vs
+        # an 0.84-0.93 oscillating plateau at 64 on the synthetic
+        # target).
         gossip=GossipConfig(algorithm="dsgd", topology="random",
                             mode="metropolis", rounds=200, local_ep=1,
-                            local_bs=64),
+                            local_bs=128),
     )
 
 

@@ -150,13 +150,16 @@ def main() -> int:
             "The grouped-stacked fleet forward (worker axis in conv "
             "feature groups) runs the 32-model round at "
             f"{dev_ms_round:.0f} ms of device time vs 2754 ms for the "
-            "vmapped per-worker path (r3). The remaining gap to the "
-            "single-weight-set bound is the irreducible-looking cost of "
-            "32 independent weight sets at CIFAR spatials "
-            "(feature_group_count=32 convs reach a lower MXU efficiency "
-            "than one dense conv of the same total size); measured "
-            "throughput stands at the fraction of that bound reported "
-            "in fleet_independence_bound.measured_fraction_of_bound."),
+            "vmapped per-worker path (r3).  Round 5's per-layer table "
+            "(results/roofline_layers_baseline5.json) showed the "
+            "grouped-conv penalty is LANE-BATCH STARVATION, not a "
+            "hardware ceiling: at the old local_bs=64 the "
+            "stride-2/1x1/deep-stage convs ran at ~0.35x of their "
+            "single-weight-set rate, recovering to ~0.9x at 128 "
+            "rows/lane.  With local_bs=128 in the preset the fleet "
+            "program stands at the fraction of the single-weight-set "
+            "bound reported in "
+            "fleet_independence_bound.measured_fraction_of_bound."),
     }
     Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     print(json.dumps({k: out[k] for k in ("measured",
