@@ -203,3 +203,39 @@ def test_stacked_eval_batches_padding():
     assert idx.shape == (2, 3, 8) and w.shape == (2, 3, 8)
     assert w.sum() == 42  # every real sample weighted once
     np.testing.assert_array_equal(idx[0].ravel()[:21], im[0])
+
+
+def test_sharded_eval_batches_partition_properties():
+    """Sharded per-worker eval: every test index appears in exactly one
+    worker's weighted region, pads carry weight 0, and shard sizes are
+    balanced to within one sample."""
+    import numpy as np
+
+    from dopt.data import sharded_eval_batches
+
+    n, w = 1003, 7           # deliberately non-divisible
+    idx, wt = sharded_eval_batches(n, w, batch_size=64)
+    assert idx.shape == wt.shape and idx.shape[0] == w
+    counted = np.zeros(n, np.int32)
+    for i in range(w):
+        real = idx[i][wt[i] > 0]
+        np.add.at(counted, real, 1)
+    assert (counted == 1).all(), "shards must partition the eval set"
+    sizes = [(wt[i] > 0).sum() for i in range(w)]
+    assert max(sizes) - min(sizes) <= 1, sizes
+    # round-robin: worker i holds indices congruent to i mod w
+    for i in range(w):
+        real = idx[i][wt[i] > 0]
+        assert (real % w == i).all()
+
+
+def test_trim_compute_dtype_table_is_valid():
+    """The per-preset trim dtype table names real presets and valid
+    dtypes (it drives bench_suite and time_to_target)."""
+    import jax.numpy as jnp
+
+    from dopt.presets import PRESETS, TRIM_COMPUTE_DTYPE
+
+    for name, dtype in TRIM_COMPUTE_DTYPE.items():
+        assert name in PRESETS, name
+        jnp.dtype(dtype)
