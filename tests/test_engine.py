@@ -727,3 +727,39 @@ def test_from_reference_args_rejects_unequal(devices):
         from_reference_args({"dataset": "mnist", "unequal": True})
     cfg = from_reference_args({"dataset": "mnist"})
     assert not hasattr(cfg.data, "unequal")
+
+
+def test_sharded_eval_mode_tracks_full(devices):
+    """eval_mode='sharded' must produce per-round fleet-mean metrics
+    close to the full-set eval (unbiased 1/W-shard estimate) and leave
+    trainer.evaluate() at reference full-set semantics."""
+    accs = {}
+    for mode in ("full", "sharded"):
+        tr = GossipTrainer(_gossip_cfg(
+            gossip={"eval_mode": mode}, iid=False))
+        h = tr.run(rounds=4)
+        accs[mode] = [r["avg_test_acc"] for r in h if "avg_test_acc" in r]
+        # evaluate() is full-set in both modes: per-worker counts equal
+        # the whole test split (128 in _gossip_cfg).
+        ev = tr.evaluate()
+        assert int(ev["count"][0]) == 128
+    assert abs(accs["full"][-1] - accs["sharded"][-1]) < 0.12, accs
+
+
+def test_sharded_eval_composes_with_dropout_and_choco(devices):
+    """The sharded evaluator must slot into the same block program as
+    fault injection and CHOCO compression (shape contract: [W]-dict)."""
+    tr = GossipTrainer(_gossip_cfg(gossip={
+        "eval_mode": "sharded", "dropout": 0.25}))
+    h = tr.run(rounds=3)
+    assert any("avg_test_acc" in r for r in h)
+    tr2 = GossipTrainer(_gossip_cfg(gossip={
+        "algorithm": "choco", "eval_mode": "sharded",
+        "compression": "topk", "compression_ratio": 1.0}))
+    h2 = tr2.run(rounds=3)
+    assert any("avg_test_acc" in r for r in h2)
+
+
+def test_eval_mode_validation():
+    with pytest.raises(ValueError, match="eval_mode"):
+        GossipTrainer(_gossip_cfg(gossip={"eval_mode": "bogus"}))
