@@ -162,19 +162,27 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
         "samples_per_sec": med * samples_per_round,
     }
     if device_blocks:
-        from dopt.utils.profiling import device_time_of
+        try:
+            from dopt.utils.profiling import device_time_of
 
-        def one_block():
-            trainer.run(rounds=rounds, block=block)
-            jax.block_until_ready(trainer.params)
+            def one_block():
+                trainer.run(rounds=rounds, block=block)
+                jax.block_until_ready(trainer.params)
 
-        dev_us = [device_time_of(one_block) for _ in range(device_blocks)]
-        trained += rounds * device_blocks
-        dev_ms = statistics.median(dev_us) / 1e3 / rounds
-        out["device_ms_per_round"] = dev_ms
-        out["device_rounds_per_sec"] = 1e3 / dev_ms
-        out["device_spread_pct"] = (100.0 * (max(dev_us) - min(dev_us))
-                                    / statistics.median(dev_us))
+            dev_us = [device_time_of(one_block)
+                      for _ in range(device_blocks)]
+            trained += rounds * device_blocks
+            dev_ms = statistics.median(dev_us) / 1e3 / rounds
+            out["device_ms_per_round"] = dev_ms
+            out["device_rounds_per_sec"] = 1e3 / dev_ms
+            out["device_spread_pct"] = (100.0 * (max(dev_us) - min(dev_us))
+                                        / statistics.median(dev_us))
+        except Exception as e:  # pragma: no cover - environment-dependent
+            # The device-time basis needs the profiler + xprof stack;
+            # its absence (or a tunnel hiccup) must not take down the
+            # wall-clock benchmark the driver records.
+            print(f"# device-time basis unavailable: {e!r}",
+                  file=sys.stderr)
     # Post-run accuracy reflects ALL rounds trained above (ADVICE r4):
     # the count is recorded so the accuracy column is interpretable.
     out["total_trained_rounds"] = trained

@@ -195,3 +195,16 @@ def test_stacked_cnn_apply_non_square_input():
     ref = m.apply({"params": p1}, x[0])
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_resnet_stage_sizes_override():
+    """stage_sizes builds shallow ResNet variants (dryrun/test trims) and
+    is rejected for non-resnet models."""
+    m = build_model("resnet18", stage_sizes=(1, 1))
+    p = _init(m, (8, 8, 1))
+    blocks = [k for k in p if k.startswith("ResidualBlock")]
+    assert len(blocks) == 2, blocks
+    out = m.apply({"params": p}, jnp.zeros((2, 8, 8, 1)))
+    assert out.shape == (2, 10)
+    with pytest.raises(ValueError, match="resnet18 only"):
+        build_model("mlp", stage_sizes=(1, 1))
