@@ -233,6 +233,51 @@ class GossipConfig:
 
 
 @dataclass(frozen=True)
+class FaultConfig:
+    """Deterministic fault injection (``dopt.faults.FaultPlan``).
+
+    The reference assumes every simulated worker is alive and instant
+    (SURVEY §5); real decentralized systems treat crashes, stragglers
+    and partitions as the steady state.  All draws are keyed by
+    (seed, round) — stateless — so the same config replays the same
+    fault trace, per-round and blocked execution inject identical
+    faults, and a killed-and-resumed run sees exactly the faults a
+    continuous run would.  Every injected fault lands in the run's
+    fault ledger (``History.faults``)."""
+
+    crash: float = 0.0
+    # Per-round per-worker crash probability.  A crashed worker is down
+    # for the round: it skips consensus and local training (gossip) or
+    # contributes nothing to the server aggregate (federated) and
+    # rejoins next round with stale-but-valid state.
+    straggle: float = 0.0
+    # Per-round per-worker straggler probability (crashes win ties).
+    straggle_frac: float = 0.5
+    # Fraction of its local work a straggler finishes before the round
+    # deadline: epochs under the holdout's epoch loop, SGD steps on the
+    # flat path (ceil(frac * total), so frac > 0 always does some work).
+    straggler_policy: str = "partial"
+    # Federated only: 'partial' aggregates the straggler's truncated
+    # update; 'drop' removes it from the round (FedAvg-paper server
+    # deadline) — combine with over_select so the aggregate still
+    # averages ~m clients.  Gossip has no server deadline and always
+    # applies 'partial'.
+    over_select: float = 0.0
+    # Federated: sample ceil(m·(1+over_select)) clients, keep the first
+    # m survivors after crashes/deadline drops (surplus is released and
+    # ledgered) — the FedAvg-paper over-selection pattern.
+    partition: float = 0.0
+    # Per-round probability a network partition STARTS; while active,
+    # the fleet is split into partition_groups random groups.  Gossip:
+    # cross-group mixing edges are cut (matrix repaired as data,
+    # ``repair_for_partition``).  Federated: only group 0 can reach the
+    # server; other groups are unreachable for the span.
+    partition_span: int = 2     # rounds a partition lasts once started
+    partition_groups: int = 2   # number of sides of the cut
+    seed: int | None = None     # fault-stream seed; None = experiment seed
+
+
+@dataclass(frozen=True)
 class SeqLMConfig:
     """Sequence-parallel language-model training (``dopt.engine.seqlm``).
 
@@ -271,6 +316,10 @@ class ExperimentConfig:
     federated: FederatedConfig | None = None
     gossip: GossipConfig | None = None
     seqlm: SeqLMConfig | None = None
+    faults: FaultConfig | None = None
+    # Fault injection & recovery (dopt.faults.FaultPlan): crashes,
+    # stragglers, partitions for the federated/gossip engines.  None =
+    # fault-free (bit-identical to a config without the field).
     # Execution backend — the pluggable Worker(backend=...) boundary:
     # "jax" runs the TPU/mesh engines; "torch" runs the SAME experiment
     # on the faithful sequential CPU oracle (dopt.engine.torch_backend)
@@ -394,7 +443,7 @@ def from_reference_args(args: Mapping[str, Any]) -> ExperimentConfig:
 def exp_details(cfg: ExperimentConfig) -> str:
     """Human-readable config dump (reference ``exp_details``, utils.py:147-165)."""
     lines = [f"Experiment: {cfg.name}", f"  seed      : {cfg.seed}", f"  backend   : {cfg.backend}"]
-    for section in ("data", "model", "optim", "federated", "gossip"):
+    for section in ("data", "model", "optim", "federated", "gossip", "faults"):
         sub = getattr(cfg, section)
         if sub is None:
             continue

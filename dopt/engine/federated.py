@@ -45,6 +45,7 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                prepare_holdout, validate_optimizer)
+from dopt.faults import FaultPlan
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
@@ -93,6 +94,20 @@ class FederatedTrainer:
         self.num_workers = w
         self.mesh = make_worker_mesh(w, cfg.mesh_devices, cfg.mesh_hosts)
         self._sharding = worker_sharding(self.mesh)
+
+        # Fault injection (dopt.faults.FaultPlan): crashes, stragglers
+        # and partitions are drawn statelessly per round on the HOST and
+        # folded into the participation mask / lane gates — a crashed
+        # (or partition-unreachable, or deadline-dropped) sampled client
+        # contributes nothing to the aggregate and keeps its stale
+        # state; it rejoins by reloading theta when next sampled.  The
+        # device programs only ever see masks/gates/limits as data, so
+        # the fault-free compiled program is exactly the pre-fault one.
+        self.faults = FaultPlan(w, cfg.faults, seed=cfg.seed)
+        has_faults = self.faults.active
+        may_straggle = (self.faults.may_straggle
+                        and cfg.faults.straggler_policy == "partial")
+        self._may_straggle = may_straggle
 
         self.dataset = load_dataset(
             cfg.data.dataset, data_dir=cfg.data.data_dir,
@@ -188,6 +203,7 @@ class FederatedTrainer:
             rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
             update_impl="pallas" if cfg.optim.fused_update else "jnp",
             stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm,
+            with_limit=may_straggle,
         )
         # Per-epoch big-gather chunking (see gossip.py: per-step gathers
         # carry ~250 µs fixed overhead each on a v5e; slab gathers don't).
@@ -200,6 +216,10 @@ class FederatedTrainer:
                         * self.dataset.train_x.dtype.itemsize)
         epoch_chunks = pick_gather_chunks(
             spe, workers=w, batch=bs_eff, sample_bytes=sample_bytes)
+        # Straggler-deadline granularity (dopt.faults): the holdout's
+        # epoch loop gates per EPOCH, the flat path per SGD step.
+        self._straggle_units = (f.local_ep if self._holdout
+                                else f.local_ep * spe)
         local_epochs = (
             make_stacked_local_update_epochs(
                 app_f, lr=cfg.optim.lr,
@@ -207,7 +227,7 @@ class FederatedTrainer:
                 rho=cfg.optim.rho, l2=cfg.optim.weight_decay,
                 update_impl="pallas" if cfg.optim.fused_update else "jnp",
                 gather_chunks=epoch_chunks, stacked_apply=s_apply_f,
-                clip_norm=cfg.optim.clip_norm)
+                clip_norm=cfg.optim.clip_norm, with_limit=may_straggle)
             if self._holdout else None
         )
         if s_apply_f is not None and self.mesh.size > 1:
@@ -222,11 +242,14 @@ class FederatedTrainer:
 
             extra = {"sgd": "", "fedprox": "r",
                      "fedadmm": "rw", "scaffold": "rw"}[local_algorithm]
-            local = shard_over_workers(local, self.mesh,
-                                       "w" * 5 + extra, "w" * 4)
+            local = shard_over_workers(
+                local, self.mesh,
+                "w" * (6 if may_straggle else 5) + extra, "w" * 4)
             if local_epochs is not None:
                 local_epochs = shard_over_workers(
-                    local_epochs, self.mesh, "wwwwrrww" + extra, "www")
+                    local_epochs, self.mesh,
+                    ("wwwwwrrww" if may_straggle else "wwwwrrww") + extra,
+                    "www")
         use_holdout = self._holdout
         local_ep_n = f.local_ep
         global_eval = make_evaluator(self.model.apply)
@@ -241,22 +264,26 @@ class FederatedTrainer:
         momentum_coef = cfg.optim.momentum
         eval_train_flag = eval_train
 
-        def run_local(start, mom_in, idx, bw, train_x, train_y, vidx, vw,
-                      theta=None, alpha=None):
+        def run_local(start, mom_in, idx, bw, limits, train_x, train_y,
+                      vidx, vw, theta=None, alpha=None):
             """Dispatch the local-training phase on however many lanes
             the inputs carry: flat step scan over the shard (idiomatic)
             or, with the holdout on, the reference's epoch loop with
             per-epoch local-val eval.  Returns (p, m, losses, accs, em)
             with losses/accs per-step [lanes, S] or per-epoch [lanes, E]
             (``mean(axis=1)`` is the round metric either way) and em the
-            per-epoch history arrays ({} when the holdout is off)."""
+            per-epoch history arrays ({} when the holdout is off).
+            ``limits`` is the per-lane straggler work budget
+            (dopt.faults), consumed only when the plan can straggle."""
             if use_holdout:
                 lanes = idx.shape[0]
                 se = idx.shape[1] // local_ep_n
                 idx_e = idx.reshape(lanes, local_ep_n, se, idx.shape[2])
                 bw_e = bw.reshape(idx_e.shape)
-                args = (start, mom_in, idx_e, bw_e, train_x, train_y,
-                        vidx, vw)
+                args = ((start, mom_in, idx_e, bw_e, limits, train_x,
+                         train_y, vidx, vw) if may_straggle else
+                        (start, mom_in, idx_e, bw_e, train_x, train_y,
+                         vidx, vw))
                 if algorithm == "fedavg":
                     p_t, m_t, em = local_epochs(*args)
                 elif algorithm == "fedprox":
@@ -266,18 +293,18 @@ class FederatedTrainer:
                 return p_t, m_t, em["train_loss"], em["train_acc"], em
             bx = train_x[idx]
             by = train_y[idx]
+            args = ((start, mom_in, bx, by, bw, limits) if may_straggle
+                    else (start, mom_in, bx, by, bw))
             if algorithm == "fedavg":
-                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw)
+                p_t, m_t, losses, accs = local(*args)
             elif algorithm == "fedprox":
-                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
-                                               theta)
+                p_t, m_t, losses, accs = local(*args, theta)
             else:
-                p_t, m_t, losses, accs = local(start, mom_in, bx, by, bw,
-                                               theta, alpha)
+                p_t, m_t, losses, accs = local(*args, theta, alpha)
             return p_t, m_t, losses, accs, {}
 
         def algo_step(theta, start, mom_in, duals_in, c_global, idx, bw,
-                      train_x, train_y, vidx, vw):
+                      limits, train_x, train_y, vidx, vw):
             """Local update + companion-state refresh on however many
             lanes the inputs carry (all N for the full-width path, the m
             sampled for the compact path).  Returns (p_t, m_t, losses,
@@ -289,12 +316,13 @@ class FederatedTrainer:
             update."""
             if algorithm == "fedavg":
                 p_t, m_t, losses, accs, em = run_local(
-                    start, mom_in, idx, bw, train_x, train_y, vidx, vw)
+                    start, mom_in, idx, bw, limits, train_x, train_y,
+                    vidx, vw)
                 sub_new = duals_in
             elif algorithm == "fedprox":
                 p_t, m_t, losses, accs, em = run_local(
-                    start, mom_in, idx, bw, train_x, train_y, vidx, vw,
-                    theta=theta)
+                    start, mom_in, idx, bw, limits, train_x, train_y,
+                    vidx, vw, theta=theta)
                 sub_new = duals_in
             elif algorithm == "scaffold":
                 # Sampled workers restart from theta with a FRESH momentum
@@ -304,19 +332,35 @@ class FederatedTrainer:
                 # heavy-ball amplification of the displacement.
                 mom0 = jax.tree.map(jnp.zeros_like, mom_in)
                 p_t, m_t, losses, accs, em = run_local(
-                    start, mom0, idx, bw, train_x, train_y, vidx, vw,
-                    theta=c_global, alpha=duals_in)
+                    start, mom0, idx, bw, limits, train_x, train_y,
+                    vidx, vw, theta=c_global, alpha=duals_in)
                 steps = bw.shape[1]
                 lr_eff = lr / max(1.0 - momentum_coef, 1e-8)
-                sub_new = jax.vmap(
-                    lambda ci, y: scaffold_control_update(
-                        ci, c_global, theta, y, lr=lr_eff, num_steps=steps),
-                    in_axes=(0, 0),
-                )(duals_in, p_t)
+                if may_straggle:
+                    # Each lane refreshes its control with ITS executed
+                    # step count (a straggler's displacement theta − y_i
+                    # reflects only the steps it finished): limits are
+                    # epochs under the holdout, SGD steps otherwise.
+                    steps_exec = (limits * (steps // local_ep_n)
+                                  if use_holdout
+                                  else jnp.minimum(limits, steps))
+                    sub_new = jax.vmap(
+                        lambda ci, y, ns: scaffold_control_update(
+                            ci, c_global, theta, y, lr=lr_eff,
+                            num_steps=ns),
+                        in_axes=(0, 0, 0),
+                    )(duals_in, p_t, steps_exec)
+                else:
+                    sub_new = jax.vmap(
+                        lambda ci, y: scaffold_control_update(
+                            ci, c_global, theta, y, lr=lr_eff,
+                            num_steps=steps),
+                        in_axes=(0, 0),
+                    )(duals_in, p_t)
             else:
                 p_t, m_t, losses, accs, em = run_local(
-                    start, mom_in, idx, bw, train_x, train_y, vidx, vw,
-                    theta=theta, alpha=duals_in)
+                    start, mom_in, idx, bw, limits, train_x, train_y,
+                    vidx, vw, theta=theta, alpha=duals_in)
                 sub_new = jax.vmap(
                     lambda a, p: admm_dual_ascent(a, p, theta, rho),
                     in_axes=(0, 0),
@@ -369,12 +413,13 @@ class FederatedTrainer:
                     pack_host_metrics(jnp.asarray(local_loss), evalm,
                                       trainm, em))
 
-        def round_fn(theta, params, mom, duals, c_global, mask, idx, bweight,
-                     train_x, train_y, ex, ey, ew, tidx, tweight, vidx, vw):
+        def round_fn(theta, params, mom, duals, c_global, mask, limits, idx,
+                     bweight, train_x, train_y, ex, ey, ew, tidx, tweight,
+                     vidx, vw):
             theta_b = broadcast_to_workers(theta, w)
             start = _where_mask(mask, theta_b, params)
             p_t, m_t, losses, accs, sub_new, em = algo_step(
-                theta, start, mom, duals, c_global, idx, bweight,
+                theta, start, mom, duals, c_global, idx, bweight, limits,
                 train_x, train_y, vidx, vw)
             if algorithm in ("scaffold", "fedadmm"):
                 new_duals = _where_mask(mask, sub_new, duals)
@@ -390,6 +435,14 @@ class FederatedTrainer:
             new_m = mom if algorithm == "scaffold" else _where_mask(mask, m_t, mom)
             new_theta = masked_average(new_p, mask, mesh=agg_mesh,
                                        comm_dtype=agg_comm)
+            if has_faults:
+                # A round whose every sampled client failed leaves the
+                # global model unchanged (the masked average over zero
+                # survivors would otherwise zero theta).
+                alive_any = mask.sum() > 0
+                new_theta = jax.tree.map(
+                    lambda a, th: jnp.where(alive_any, a, th),
+                    new_theta, theta)
             local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
             # Full-width packs ALL W lanes' em rows (gathering the
             # sampled subset would be a dynamic shape); the host slices
@@ -429,20 +482,29 @@ class FederatedTrainer:
             return jax.tree.map(lambda x, s: x.at[sel].set(s), tree, sub)
 
         def compact_round_fn(theta, params, mom, duals, c_global, sel,
-                             idx_sel, bw_sel, train_x, train_y, ex, ey, ew,
-                             tidx, tweight, vidx, vw):
+                             limits_sel, idx_sel, bw_sel, train_x, train_y,
+                             ex, ey, ew, tidx, tweight, vidx, vw):
             """Compact-sampling fast path: only the m = len(sel) sampled
             workers' lanes are trained ([m, ...] gather → local update →
             scatter-back), instead of all N lanes computing and the mask
             discarding N−m results.  Identical math to ``round_fn`` up to
             float summation order (the sampled average sums m terms
-            directly rather than N mask-weighted ones)."""
+            directly rather than N mask-weighted ones).  Under fault
+            injection ``sel`` carries the round's SURVIVORS (the host
+            drops crashed / unreachable / deadline-dropped clients before
+            the device step), so the sampled mean is the masked average
+            over survivors, same as the full-width path.  Survivor
+            counts vary round to round and jit retraces per distinct
+            count — acceptable on the single-device (CPU-compile)
+            meshes this path is restricted to; heavily-faulted sharded
+            runs use the full-width path, whose shapes never change."""
             m = sel.shape[0]
             start = broadcast_to_workers(theta, m)
             duals_sel = _take(duals, sel)
             p_t, m_t, losses, accs, sub_new, em = algo_step(
                 theta, start, _take(mom, sel), duals_sel, c_global,
-                idx_sel, bw_sel, train_x, train_y, vidx[sel], vw[sel])
+                idx_sel, bw_sel, limits_sel, train_x, train_y,
+                vidx[sel], vw[sel])
             if algorithm in ("scaffold", "fedadmm"):
                 new_duals = _scatter(duals, sel, sub_new)
             else:
@@ -466,21 +528,21 @@ class FederatedTrainer:
             global + per-client train eval — so history rows are
             identical to the per-round path's."""
 
-            def block_fn(theta, params, mom, duals, c_global, gates, idxs,
-                         bws, train_x, train_y, ex, ey, ew, tidx, tweight,
-                         vidx, vw):
+            def block_fn(theta, params, mom, duals, c_global, gates, limits,
+                         idxs, bws, train_x, train_y, ex, ey, ew, tidx,
+                         tweight, vidx, vw):
                 def body(carry, xs):
                     th, p, m, d, c = carry
-                    gate, idx, bw = xs
+                    gate, lim, idx, bw = xs
                     th, p, m, d, c, packed = one_round(
-                        th, p, m, d, c, gate, idx, bw,
+                        th, p, m, d, c, gate, lim, idx, bw,
                         train_x, train_y, ex, ey, ew, tidx, tweight,
                         vidx, vw)
                     return (th, p, m, d, c), packed
 
                 carry, packed = jax.lax.scan(
                     body, (theta, params, mom, duals, c_global),
-                    (gates, idxs, bws))
+                    (gates, limits, idxs, bws))
                 return (*carry, packed)
 
             return jax.jit(block_fn, donate_argnums=(1, 2, 3))
@@ -503,6 +565,68 @@ class FederatedTrainer:
         mask = np.zeros(self.num_workers, np.float32)
         mask[self._sample_indices(frac)] = 1.0
         return mask
+
+    def _round_participation(
+            self, t: int, frac: float) -> tuple[np.ndarray, np.ndarray]:
+        """Sample round t's clients and apply its faults: returns
+        (survivor indices, [W] straggler work limits).
+
+        Fault-free this is exactly ``_sample_indices`` (same RNG call,
+        same stream — enabling the fault machinery never perturbs the
+        sampling sequence).  With faults on, the FedAvg-paper server
+        deadline runs on the host: over-select ceil(m·(1+over_select))
+        clients, drop the crashed / partition-unreachable /
+        deadline-dropped ones, keep the first m survivors and release
+        the surplus.  Every action lands in the fault ledger
+        (``history.faults``) — draws are stateless per round
+        (dopt.faults.FaultPlan), so per-round, blocked, and
+        killed-and-resumed execution log the identical trace."""
+        m = max(int(frac * self.num_workers), 1)
+        c = self.faults.cfg
+        n_draw = m
+        if self.faults.active and c.over_select > 0.0:
+            n_draw = min(int(np.ceil(m * (1.0 + c.over_select))),
+                         self.num_workers)
+        # Keep the RNG's DRAW order for the survivor cut below: the
+        # over-selection surplus must be released uniformly (sorting
+        # first would systematically release the highest worker ids,
+        # biasing participation toward low ids); the final survivor
+        # set is sorted on return.
+        chosen = self._sample_rng.choice(
+            self.num_workers, n_draw, replace=False).astype(np.int32)
+        rf = self.faults.for_round(t)
+        limits = FaultPlan.limits_for(rf, self._straggle_units)
+        if not rf.any_fault and n_draw == m:
+            return np.sort(chosen), limits
+        drop_policy = c is not None and c.straggler_policy == "drop"
+        survivors: list[int] = []
+        for i in chosen:
+            i = int(i)
+            if rf.crashed[i]:
+                self.history.log_fault(round=t, worker=i, kind="crash",
+                                       action="dropped_from_round")
+            elif rf.partition is not None and rf.partition[i] != 0:
+                # Only group 0 can reach the server for the span.
+                self.history.log_fault(
+                    round=t, worker=i, kind="partition",
+                    action=f"unreachable_in_group_{int(rf.partition[i])}")
+            elif rf.straggler[i] and drop_policy:
+                self.history.log_fault(round=t, worker=i, kind="straggler",
+                                       action="deadline_dropped")
+            else:
+                survivors.append(i)
+        for i in survivors[m:]:
+            self.history.log_fault(round=t, worker=i, kind="overselect",
+                                   action="released_surplus")
+        survivors = np.sort(np.asarray(survivors[:m], np.int32))
+        if self._may_straggle:
+            for i in survivors:
+                if rf.straggler[i]:
+                    self.history.log_fault(
+                        round=t, worker=int(i), kind="straggler",
+                        action=(f"truncated_to_{int(limits[i])}"
+                                f"_of_{self._straggle_units}"))
+        return survivors, limits
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
@@ -536,8 +660,15 @@ class FederatedTrainer:
             return f.compact
         return True
 
-    def _run_blocked(self, frac: float, rounds: int, block: int) -> History:
-        """Run ``rounds`` rounds in fused blocks of up to ``block``."""
+    def _run_blocked(self, frac: float, rounds: int, block: int,
+                     checkpoint_every: int = 0,
+                     checkpoint_path=None) -> History:
+        """Run ``rounds`` rounds in fused blocks of up to ``block``.
+        Periodic auto-checkpoints land at block boundaries (the state
+        only exists on the host there).  Faulted runs reach here only on
+        the full-width path (``run`` falls back to per-round execution
+        for compact + faults: survivor counts vary per round, and the
+        compact block stacks fixed-width lanes)."""
         from dopt.parallel.mesh import worker_axes
 
         cfg, f = self.cfg, self.cfg.federated
@@ -547,11 +678,14 @@ class FederatedTrainer:
         )
         t0 = time.time()
         done = 0
+        next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
+            if checkpoint_every else None
         while done < rounds:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
             with self.timers.phase("host_batch_plan"):
-                sels = [self._sample_indices(frac) for _ in ts]
+                parts = [self._round_participation(t, frac) for t in ts]
+                sels = [p[0] for p in parts]
                 plans = [
                     make_batch_plan(
                         self._train_matrix, batch_size=f.local_bs,
@@ -563,6 +697,8 @@ class FederatedTrainer:
                 ]
                 if compact:
                     gates = jnp.asarray(np.stack(sels))
+                    limits = jnp.asarray(
+                        np.stack([lim[sel] for sel, lim in parts]))
                     idx = jnp.asarray(np.stack([p.idx for p in plans]))
                     bw = jnp.asarray(np.stack([p.weight for p in plans]))
                 else:
@@ -570,6 +706,7 @@ class FederatedTrainer:
                     for j, sel in enumerate(sels):
                         masks[j, sel] = 1.0
                     gates = jnp.asarray(masks)
+                    limits = jnp.asarray(np.stack([p[1] for p in parts]))
                     idx = jax.device_put(np.stack([p.idx for p in plans]),
                                          block_sharding)
                     bw = jax.device_put(np.stack([p.weight for p in plans]),
@@ -581,7 +718,8 @@ class FederatedTrainer:
              packed) = self.timers.measure(
                 "round_step", fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
-                gates, idx, bw, self._train_x, self._train_y, *self._eval,
+                gates, limits, idx, bw, self._train_x, self._train_y,
+                *self._eval,
                 self._train_eval_idx, self._train_eval_w, *self._val,
             )
             if self.duals is not None:
@@ -607,52 +745,78 @@ class FederatedTrainer:
                     self._append_client_rows(t, em, sels[j])
                 self.round += 1
             done += k
+            if next_ckpt is not None and self.round >= next_ckpt:
+                self.save(checkpoint_path)
+                next_ckpt = (self.round // checkpoint_every + 1) \
+                    * checkpoint_every
         self.total_time = time.time() - t0
         return self.history
 
     def run(self, frac: float | None = None, rounds: int | None = None,
-            block: int | None = None) -> History:
+            block: int | None = None, checkpoint_every: int = 0,
+            checkpoint_path=None) -> History:
         """Train; ``block`` (default ``cfg.federated.block_rounds``) > 1
         fuses that many rounds into one jit dispatch — same math, same
         per-round eval cadence, same client-sampling sequence; only the
-        host/device round-trip count changes."""
+        host/device round-trip count changes.
+
+        ``checkpoint_every=K`` (with ``checkpoint_path``) auto-saves a
+        full checkpoint every K rounds; a run killed at any point and
+        resumed from the latest checkpoint is bit-identical to a
+        continuous run (stateless fault/batch streams + persisted
+        sampling-RNG state)."""
         cfg, f = self.cfg, self.cfg.federated
         frac = f.frac if frac is None else frac
         rounds = f.rounds if rounds is None else rounds
         block = f.block_rounds if block is None else block
-        if block > 1:
-            return self._run_blocked(frac, rounds, block)
+        if checkpoint_every and checkpoint_path is None:
+            raise ValueError("checkpoint_every requires checkpoint_path")
+        if block > 1 and not (self.faults.active
+                              and self._use_compact(frac)):
+            # Compact + faults stays per-round: survivor counts vary
+            # round to round and the compact block stacks fixed-width
+            # lane sets.
+            return self._run_blocked(frac, rounds, block,
+                                     checkpoint_every=checkpoint_every,
+                                     checkpoint_path=checkpoint_path)
         compact = self._use_compact(frac)
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                sel = self._sample_indices(frac)
+                sel, limits = self._round_participation(t, frac)
+                # The compact path needs >= 1 survivor lane; a round
+                # whose every sampled client failed degrades to one
+                # full-width step with an all-zero mask (theta and all
+                # worker state pass through unchanged).
+                use_c = compact and sel.size > 0
                 # Compact path: plan only the m sampled workers' rows —
                 # host cost O(m), and the RNG is keyed by true worker id
                 # so the plans are bit-identical to the full plan's rows.
                 plan = make_batch_plan(
                     self._train_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
-                    workers=sel if compact else None,
+                    workers=sel if use_c else None,
                 )
-                if compact:
+                if use_c:
                     idx = jnp.asarray(plan.idx)
                     bweight = jnp.asarray(plan.weight)
+                    lim_dev = jnp.asarray(limits[sel])
                 else:
                     mask = np.zeros(self.num_workers, np.float32)
                     mask[sel] = 1.0
                     idx = jax.device_put(plan.idx, self._sharding)
                     bweight = jax.device_put(plan.weight, self._sharding)
+                    lim_dev = jnp.asarray(limits)
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
-            step_fn = self._compact_fn if compact else self._round_fn
-            gate = jnp.asarray(sel) if compact else jnp.asarray(mask)
+            step_fn = self._compact_fn if use_c else self._round_fn
+            gate = jnp.asarray(sel) if use_c else jnp.asarray(mask)
             (self.theta, self.params, self.momentum, new_duals, new_c,
              packed) = self.timers.measure(
                 "round_step", step_fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
-                gate, idx, bweight,
+                gate, lim_dev, idx, bweight,
                 self._train_x, self._train_y, *self._eval,
                 self._train_eval_idx, self._train_eval_w, *self._val,
             )
@@ -660,7 +824,7 @@ class FederatedTrainer:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
-            lanes = len(sel) if compact else self.num_workers
+            lanes = len(sel) if use_c else self.num_workers
             ll, acc, loss_sum, t_loss, t_acc, em = self._unpack_host_metrics(
                 np.asarray(packed), lanes)  # ONE device→host fetch per round
             self.history.append(
@@ -672,10 +836,12 @@ class FederatedTrainer:
                 local_loss=ll,
             )
             if self._holdout:
-                if not compact:
+                if not use_c:
                     em = {k_: v[sel] for k_, v in em.items()}
                 self._append_client_rows(t, em, sel)
             self.round += 1
+            if checkpoint_every and self.round % checkpoint_every == 0:
+                self.save(checkpoint_path)
         self.total_time = time.time() - t0
         return self.history
 
@@ -731,6 +897,7 @@ class FederatedTrainer:
                   "algorithm": self.cfg.federated.algorithm,
                   "history": self.history.rows,
                   "client_history": self.client_history.rows,
+                  "fault_ledger": self.history.faults,
                   "sample_rng_state": self._sample_rng.bit_generator.state},
         )
 
@@ -763,6 +930,7 @@ class FederatedTrainer:
                                            self._replicated)
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
+        self.history.faults = list(meta.get("fault_ledger", []))
         self.client_history.rows = list(meta.get("client_history", []))
         if meta.get("sample_rng_state"):
             self._sample_rng.bit_generator.state = meta["sample_rng_state"]

@@ -45,8 +45,10 @@ from dopt.parallel.collectives import mix_dense, mix_shifts, where_mask
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
                                 worker_sharding)
+from dopt.faults import FaultPlan
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
                            coeffs_for_matrix, repair_for_dropout,
+                           repair_for_partition,
                            schedule_shift_decomposition)
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
@@ -233,11 +235,15 @@ class GossipTrainer:
             self.mixing = None
 
         self._matching_rng = host_rng(cfg.seed, 60551)
-        # Fault injection (worker dropout): draw per-round alive masks on
-        # the host; the mixing matrix is repaired as data and dead lanes
-        # keep their state via where_mask (elastic rejoin).
-        self._dropout_rng = host_rng(cfg.seed, 424242)
-        has_dropout = g.dropout > 0.0
+        # Fault injection (dopt.faults.FaultPlan): crashes, stragglers
+        # and partitions drawn statelessly per round on the host; the
+        # mixing matrix is repaired as data and dead lanes keep their
+        # state via where_mask (elastic rejoin).  ``GossipConfig.dropout``
+        # is the back-compat alias for crash-only faults.
+        self.faults = FaultPlan(w, cfg.faults, seed=cfg.seed,
+                                dropout=g.dropout)
+        has_faults = self.faults.active
+        may_straggle = self.faults.may_straggle
 
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
@@ -257,6 +263,9 @@ class GossipTrainer:
             sample_bytes=sample_bytes)
         epoch_chunks = pick_gather_chunks(
             spe, workers=w, batch=bs_eff, sample_bytes=sample_bytes)
+        # Straggler-deadline granularity: the holdout's epoch loop gates
+        # per EPOCH, the flat path per SGD step over the whole plan.
+        self._straggle_units = g.local_ep if self._holdout else g.local_ep * spe
         # Grouped stacked-forward fast path (make_stacked_apply): the
         # whole fleet's forward as one feature-grouped conv program
         # instead of vmap-over-workers (~3× step speedup on TPU).
@@ -275,17 +284,24 @@ class GossipTrainer:
         app_f = flat_input_apply(self.model.apply, self._sample_shape)
         s_apply_f = (flat_input_stacked_apply(s_apply, self._sample_shape)
                      if s_apply is not None else None)
+        # may_straggle keys the compiled local-update shape: the
+        # with_limit variants thread a [W] work budget (epochs under the
+        # holdout, SGD steps on the flat path) that freezes a straggler's
+        # params/momentum at its deadline.  Fault-free configs compile
+        # the exact pre-fault program.
         local = make_stacked_local_update(
             app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
             stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm,
+            with_limit=may_straggle,
         )
         local_epochs = (
             make_stacked_local_update_epochs(
                 app_f, lr=cfg.optim.lr,
                 momentum=cfg.optim.momentum, algorithm="sgd", l2=l2,
                 update_impl=update_impl, gather_chunks=epoch_chunks,
-                stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm)
+                stacked_apply=s_apply_f, clip_norm=cfg.optim.clip_norm,
+                with_limit=may_straggle)
             if self._holdout else None
         )
         if s_apply_f is not None and self.mesh.size > 1:
@@ -294,10 +310,12 @@ class GossipTrainer:
             # under shard_map (dopt.parallel.mesh.shard_over_workers):
             # per-device lanes, local feature-group count, zero
             # collectives.
-            local = shard_over_workers(local, self.mesh, "w" * 5, "w" * 4)
+            local = shard_over_workers(
+                local, self.mesh, "w" * (6 if may_straggle else 5), "w" * 4)
             if local_epochs is not None:
                 local_epochs = shard_over_workers(
-                    local_epochs, self.mesh, "wwwwrrww", "www")
+                    local_epochs, self.mesh,
+                    "wwwwwrrww" if may_straggle else "wwwwrrww", "www")
         use_holdout = self._holdout
         local_ep_n = g.local_ep
         full_evaluator = make_stacked_evaluator(self.model.apply,
@@ -341,7 +359,7 @@ class GossipTrainer:
         self._shift_ids: tuple[int, ...] | None = None
         if g.comm_impl != "dense" and self.mixing is not None and (do_mix or is_choco):
             flat_1d = len(mesh.axis_names) == 1
-            extra = (0,) if has_dropout else ()
+            extra = (0,) if self.faults.affects_matrix else ()
             ids = (schedule_shift_decomposition(self.mixing, max_shifts=None,
                                                 extra_shifts=extra)
                    if flat_1d else None)
@@ -432,7 +450,7 @@ class GossipTrainer:
             key = jax.random.fold_in(choco_key, t)
             diff = jax.tree.map(lambda a, b: a - b, params, x_hat)
             q = compressor(diff, key)
-            if has_dropout:
+            if has_faults:
                 # Dead workers send nothing: their public copy freezes.
                 q = where_mask(alive, q, jax.tree.map(jnp.zeros_like, q))
             x_hat = jax.tree.map(lambda a, b: a + b, x_hat, q)
@@ -448,30 +466,41 @@ class GossipTrainer:
 
         def train_metrics(losses, accs, alive):
             """Mean over steps per worker, then over ALIVE workers only."""
-            if not has_dropout:
+            if not has_faults:
                 return losses.mean(), accs.mean()
             denom = jnp.maximum(alive.sum(), 1.0)
             return ((losses.mean(axis=1) * alive).sum() / denom,
                     (accs.mean(axis=1) * alive).sum() / denom)
 
         def local_phase(params, mom, idx, bweight, train_x, train_y,
-                        vidx, vw):
+                        vidx, vw, limits):
             """The per-round local-training phase: flat step scan on the
             full shard, or (holdout mode) the reference's epoch loop with
             per-epoch local-val eval.  Returns (p, m, losses, accs, em)
             where losses/accs are per-step [W, S] or per-epoch [W, E] —
             either way ``mean(axis=1)`` is the round's train metric —
-            and em carries the per-epoch history arrays ({} when off)."""
+            and em carries the per-epoch history arrays ({} when off).
+            ``limits`` is the [W] straggler work budget, consumed only
+            when the plan can straggle (ignored otherwise)."""
             if use_holdout:
                 se = idx.shape[1] // local_ep_n
                 idx_e = idx.reshape(idx.shape[0], local_ep_n, se, idx.shape[2])
                 bw_e = bweight.reshape(idx_e.shape)
-                p_t, m_t, em = local_epochs(params, mom, idx_e, bw_e,
-                                            train_x, train_y, vidx, vw)
+                if may_straggle:
+                    p_t, m_t, em = local_epochs(params, mom, idx_e, bw_e,
+                                                limits, train_x, train_y,
+                                                vidx, vw)
+                else:
+                    p_t, m_t, em = local_epochs(params, mom, idx_e, bw_e,
+                                                train_x, train_y, vidx, vw)
                 return p_t, m_t, em["train_loss"], em["train_acc"], em
             bx = train_x[idx]
             by = train_y[idx]
-            p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
+            if may_straggle:
+                p_t, m_t, losses, accs = local(params, mom, bx, by, bweight,
+                                               limits)
+            else:
+                p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
             return p_t, m_t, losses, accs, {}
 
         def pack_host_metrics(tl, ta, evalm, em):
@@ -492,8 +521,9 @@ class GossipTrainer:
             return jnp.concatenate(
                 [p.astype(jnp.float32) for p in parts])
 
-        def round_fn(params, mom, x_hat, w_matrix, alive, t, idx, bweight,
-                     train_x, train_y, ex, ey, ew, vidx, vw, do_eval):
+        def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
+                     bweight, train_x, train_y, ex, ey, ew, vidx, vw,
+                     do_eval):
             if is_choco:
                 params, x_hat = choco_mix(params, x_hat, w_matrix, alive, t)
             elif do_mix:
@@ -504,8 +534,9 @@ class GossipTrainer:
                 zeros_eval,
             )
             p_t, m_t, losses, accs, em = local_phase(
-                params, mom, idx, bweight, train_x, train_y, vidx, vw)
-            if has_dropout:
+                params, mom, idx, bweight, train_x, train_y, vidx, vw,
+                limits)
+            if has_faults:
                 # Dead workers skip the local update (their lanes compute
                 # and are discarded — static shapes).
                 p_t = where_mask(alive, p_t, params)
@@ -523,15 +554,16 @@ class GossipTrainer:
             app_f, lr=cfg.optim.lr, momentum=cfg.optim.momentum,
             algorithm="sgd", l2=l2, update_impl=update_impl,
             gather_chunks=self._gather_chunks, stacked_apply=s_apply_f,
-            clip_norm=cfg.optim.clip_norm,
+            clip_norm=cfg.optim.clip_norm, with_limit=may_straggle,
         )
         if s_apply_f is not None and self.mesh.size > 1:
             self._local_gather = shard_over_workers(
-                self._local_gather, self.mesh, "wwwwrr", "w" * 4)
+                self._local_gather, self.mesh,
+                "wwwwwrr" if may_straggle else "wwwwrr", "w" * 4)
         local_g, ev = self._local_gather, self._evaluator
 
-        def block_fn(params, mom, x_hat, w_mats, alive, ts, idx, bw, is_eval,
-                     train_x, train_y, ex, ey, ew, vidx, vw):
+        def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
+                     is_eval, train_x, train_y, ex, ey, ew, vidx, vw):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -542,7 +574,7 @@ class GossipTrainer:
 
             def body(carry, xs):
                 p, m, xh = carry
-                w_t, alive_t, t_t, idx_t, bw_t, ev_t = xs
+                w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
                 if is_choco:
                     p, xh = choco_mix(p, xh, w_t, alive_t, t_t)
                 elif do_mix:
@@ -550,33 +582,43 @@ class GossipTrainer:
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 if use_holdout:
                     p_t, m_t, losses, accs, em = local_phase(
-                        p, m, idx_t, bw_t, train_x, train_y, vidx, vw)
+                        p, m, idx_t, bw_t, train_x, train_y, vidx, vw, lim_t)
+                elif may_straggle:
+                    p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t, lim_t,
+                                                     train_x, train_y)
+                    em = {}
                 else:
                     p_t, m_t, losses, accs = local_g(p, m, idx_t, bw_t,
                                                      train_x, train_y)
                     em = {}
-                if has_dropout:
+                if has_faults:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
                 return (p_t, m_t, xh), pack_host_metrics(tl, ta, evalm, em)
 
             (params, mom, x_hat), packed = jax.lax.scan(
-                body, (params, mom, x_hat), (w_mats, alive, ts, idx, bw,
-                                             is_eval)
+                body, (params, mom, x_hat), (w_mats, alive, limits, ts, idx,
+                                             bw, is_eval)
             )
             return params, mom, x_hat, packed
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
 
-    def _run_blocked(self, rounds: int, block: int) -> History:
-        """Run ``rounds`` rounds in fused blocks of up to ``block``."""
+    def _run_blocked(self, rounds: int, block: int,
+                     checkpoint_every: int = 0,
+                     checkpoint_path=None) -> History:
+        """Run ``rounds`` rounds in fused blocks of up to ``block``.
+        Periodic auto-checkpoints land at block boundaries (the state
+        only exists on the host there)."""
         cfg, g = self.cfg, self.cfg.gossip
         block_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
         )
         t0 = time.time()
         done = 0
+        next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
+            if checkpoint_every else None
         while done < rounds:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
@@ -584,6 +626,7 @@ class GossipTrainer:
                 pairs = [self._round_inputs(t) for t in ts]
                 w_mats = np.stack([p[0] for p in pairs])
                 alive = np.stack([p[1] for p in pairs])
+                limits = np.stack([p[2] for p in pairs])
                 plans = [
                     make_batch_plan(self._train_matrix, batch_size=g.local_bs,
                                     local_ep=g.local_ep, seed=cfg.seed,
@@ -601,7 +644,7 @@ class GossipTrainer:
              packed) = self.timers.measure(
                 "round_step", self._block_fn,
                 self.params, self.momentum, self.x_hat, w_mats, alive,
-                jnp.asarray(ts, jnp.int32), idx, bw,
+                limits, jnp.asarray(ts, jnp.int32), idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
                 *self._eval, *self._val,
             )
@@ -621,6 +664,10 @@ class GossipTrainer:
                     self._append_client_rows(t, em)
                 self.round += 1
             done += k
+            if next_ckpt is not None and self.round >= next_ckpt:
+                self.save(checkpoint_path)
+                next_ckpt = (self.round // checkpoint_every + 1) \
+                    * checkpoint_every
         self.total_time = time.time() - t0
         return self.history
 
@@ -664,52 +711,75 @@ class GossipTrainer:
             return self.mixing.for_round(t)
         return np.eye(self.num_workers)
 
-    def _alive_for_round(self) -> np.ndarray:
-        """Per-round fault injection: 0/1 alive mask (all alive when
-        cfg.gossip.dropout == 0; stateful host RNG so per-round and
-        blocked execution draw the same failure sequence)."""
-        g = self.cfg.gossip
-        if g.dropout <= 0.0:
-            return np.ones(self.num_workers, np.float32)
-        return (self._dropout_rng.random(self.num_workers)
-                >= g.dropout).astype(np.float32)
+    def _round_inputs(
+            self, t: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(mixing argument, alive mask, straggler limits) for round t,
+        with the matrix repaired for any failed workers and every
+        injected fault appended to the ledger (``history.faults``).
 
-    def _round_inputs(self, t: int) -> tuple[np.ndarray, np.ndarray]:
-        """(mixing argument, alive mask) for round t, with the matrix
-        repaired for any failed workers.  The mixing argument is the
-        [n, n] matrix on the dense path or its [k, n] circulant
-        coefficient table on the shift/ppermute path (same math:
-        ``coeffs_for_matrix`` raises if the matrix ever leaves the
-        compiled shift set, so the two paths can never silently
-        diverge)."""
+        The mixing argument is the [n, n] matrix on the dense path or
+        its [k, n] circulant coefficient table on the shift/ppermute
+        path (same math: ``coeffs_for_matrix`` raises if the matrix
+        ever leaves the compiled shift set, so the two paths can never
+        silently diverge).  Faults are drawn statelessly per round
+        (dopt.faults.FaultPlan), so per-round and blocked execution —
+        and a killed-and-resumed run — see the identical trace."""
         w_t = self._matrix_for_round(t)
-        alive = self._alive_for_round()
+        rf = self.faults.for_round(t)
+        alive = (~rf.crashed).astype(np.float32)
+        units = self._straggle_units
+        limits = FaultPlan.limits_for(rf, units)
+        if rf.partition is not None:
+            # Cut cross-group edges FIRST, then repair for crashes: a
+            # crashed worker is down regardless of which side it is on.
+            w_t = repair_for_partition(w_t, rf.partition)
+            for i, gid in enumerate(rf.partition):
+                self.history.log_fault(round=t, worker=i, kind="partition",
+                                       action=f"cut_to_group_{int(gid)}")
         if alive.min() < 1.0:
             w_t = repair_for_dropout(w_t, alive)
+        for i in np.nonzero(rf.crashed)[0]:
+            self.history.log_fault(round=t, worker=i, kind="crash",
+                                   action="skipped_round")
+        for i in np.nonzero(rf.straggler)[0]:
+            self.history.log_fault(
+                round=t, worker=i, kind="straggler",
+                action=f"truncated_to_{int(limits[i])}_of_{units}")
         if self._shift_ids is not None:
-            return coeffs_for_matrix(w_t, self._shift_ids), alive
-        return w_t.astype(np.float32), alive
+            return coeffs_for_matrix(w_t, self._shift_ids), alive, limits
+        return w_t.astype(np.float32), alive, limits
 
     def run(self, rounds: int | None = None, eps: int | None = None,
-            block: int | None = None) -> History:
+            block: int | None = None, checkpoint_every: int = 0,
+            checkpoint_path=None) -> History:
         """Train; mirrors ``Simulator.run(rounds)`` / ``FedLCon.run(rounds, eps)``.
 
         ``block`` (default ``cfg.gossip.block_rounds``) > 1 fuses that
         many rounds into one jit dispatch (``_run_blocked``) — same
         math, same phase order, same eval cadence; only the host/device
-        round-trip count changes."""
+        round-trip count changes.
+
+        ``checkpoint_every=K`` (with ``checkpoint_path``) auto-saves a
+        full checkpoint every K rounds; a run killed at any point and
+        resumed from the latest checkpoint is bit-identical to a
+        continuous run (stateless fault/batch streams + persisted host
+        RNG state)."""
         cfg, g = self.cfg, self.cfg.gossip
         rounds = g.rounds if rounds is None else rounds
         if eps is not None and eps != g.eps and g.algorithm == "fedlcon":
             raise ValueError("set eps in GossipConfig (static for compilation)")
+        if checkpoint_every and checkpoint_path is None:
+            raise ValueError("checkpoint_every requires checkpoint_path")
         block = g.block_rounds if block is None else block
         if block > 1:
-            return self._run_blocked(rounds, block)
+            return self._run_blocked(rounds, block,
+                                     checkpoint_every=checkpoint_every,
+                                     checkpoint_path=checkpoint_path)
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                w_t, alive = self._round_inputs(t)
+                w_t, alive, limits = self._round_inputs(t)
                 plan = make_batch_plan(
                     self._train_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
@@ -720,7 +790,7 @@ class GossipTrainer:
             (self.params, self.momentum, self.x_hat,
              packed) = self.timers.measure(
                 "round_step", self._round_fn,
-                self.params, self.momentum, self.x_hat, w_t, alive,
+                self.params, self.momentum, self.x_hat, w_t, alive, limits,
                 jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
                 do_eval,
@@ -739,6 +809,9 @@ class GossipTrainer:
             if self._holdout:
                 self._append_client_rows(t, em)
             self.round += 1
+            if (checkpoint_every and
+                    self.round % checkpoint_every == 0):
+                self.save(checkpoint_path)
         self.total_time = time.time() - t0
         return self.history
 
@@ -759,8 +832,8 @@ class GossipTrainer:
                   "algorithm": self.cfg.gossip.algorithm,
                   "history": self.history.rows,
                   "client_history": self.client_history.rows,
-                  "matching_rng_state": self._matching_rng.bit_generator.state,
-                  "dropout_rng_state": self._dropout_rng.bit_generator.state},
+                  "fault_ledger": self.history.faults,
+                  "matching_rng_state": self._matching_rng.bit_generator.state},
         )
 
     def restore(self, path) -> None:
@@ -783,11 +856,23 @@ class GossipTrainer:
             self.x_hat = shard_worker_tree(arrays["x_hat"], self.mesh)
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
+        self.history.faults = list(meta.get("fault_ledger", []))
         self.client_history.rows = list(meta.get("client_history", []))
         if meta.get("matching_rng_state"):
             self._matching_rng.bit_generator.state = meta["matching_rng_state"]
         if meta.get("dropout_rng_state"):
-            self._dropout_rng.bit_generator.state = meta["dropout_rng_state"]
+            # Checkpoint from before dropout joined FaultPlan, whose
+            # draws are stateless per round: the resumed run's failure
+            # sequence is deterministic but NOT the one the stateful
+            # stream would have produced.
+            import warnings
+
+            warnings.warn(
+                "checkpoint carries the legacy stateful dropout RNG; "
+                "dropout faults now draw statelessly per round "
+                "(dopt.faults.FaultPlan), so this run's failure "
+                "sequence will differ from the original pre-upgrade "
+                "run", stacklevel=2)
 
     # Convenience: per-worker eval of the current state (reuses the
     # round step's evaluator — same wrapping, same jit cache).

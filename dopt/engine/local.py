@@ -158,6 +158,22 @@ def _make_stacked_step_core(stacked_apply, *, lr, momentum, algorithm, rho,
     return step_core
 
 
+def _gate_tree(gate, new, old):
+    """``new`` where ``gate`` else ``old``, per leaf.  ``gate`` is a
+    scalar bool (per-worker vmapped cores) or a [W] bool vector (stacked
+    cores), broadcast over each leaf's trailing dims.  The straggler
+    deadline model (``dopt.faults``) uses this to freeze a worker's
+    params/momentum once its per-round work budget is spent — static
+    shapes, no dynamic slicing, dead-cheap when every gate is on."""
+    def sel(a, b):
+        g = gate
+        if getattr(g, "ndim", 0):
+            g = g.reshape(g.shape + (1,) * (a.ndim - g.ndim))
+        return jnp.where(g, a, b)
+
+    return jax.tree.map(sel, new, old)
+
+
 def make_local_update(
     apply_fn: Callable,
     *,
@@ -168,6 +184,7 @@ def make_local_update(
     l2: float = 0.0,
     update_impl: str = "jnp",
     clip_norm: float = 0.0,
+    with_limit: bool = False,
 ):
     """Build the per-worker local-update function.
 
@@ -175,12 +192,37 @@ def make_local_update(
     'scaffold' (theta slot = server control c, alpha slot = client c_i).
     Returns fn(params, mom, bx, by, bw, theta=None, alpha=None) ->
     (new_params, new_mom, losses[S], accs[S]).
+
+    ``with_limit=True`` builds the straggler-deadline variant instead:
+    fn(params, mom, bx, by, bw, limit, theta=None, alpha=None) where
+    ``limit`` is this worker's SGD-step budget — steps i >= limit leave
+    params/momentum frozen (per-step metrics are still emitted; rows
+    past the limit reflect the frozen params).  ``limit = S`` is
+    bit-identical to the unlimited variant.
     """
     if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
                            update_impl=update_impl, clip_norm=clip_norm)
+
+    if with_limit:
+        def local_update_lim(params, mom, bx, by, bw, limit,
+                             theta=None, alpha=None):
+            steps = jnp.arange(bx.shape[0])
+
+            def step(carry, batch):
+                p, m = carry
+                x, y, w, i = batch
+                p2, m2, loss, acc = core(p, m, x, y, w, theta, alpha)
+                g = i < limit
+                return (_gate_tree(g, p2, p), _gate_tree(g, m2, m)), (loss, acc)
+
+            (params, mom), (losses, accs) = jax.lax.scan(
+                step, (params, mom), (bx, by, bw, steps))
+            return params, mom, losses, accs
+
+        return local_update_lim
 
     def local_update(params, mom, bx, by, bw, theta=None, alpha=None):
         def step(carry, batch):
@@ -207,18 +249,40 @@ def _arity_wrap(algorithm, fn):
 
 def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
                               rho=0.0, l2=0.0, update_impl="jnp",
-                              stacked_apply=None, clip_norm=0.0):
+                              stacked_apply=None, clip_norm=0.0,
+                              with_limit=False):
     """vmap the per-worker update over the leading worker axis — or,
     with ``stacked_apply`` set (``dopt.models.make_stacked_apply``), run
     the grouped-conv stacked step with NO vmap: the scan iterates over
     S-major batches and every step consumes the full [W, B, ...] slab.
 
     theta (global model) is broadcast; alpha (ADMM duals) is stacked.
+    ``with_limit=True`` builds the straggler-deadline variant: a [W]
+    int ``limit`` rides after ``bw`` and worker w's params/momentum
+    freeze from step limit[w] on (``make_local_update``).
     """
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
             rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
+
+        if with_limit:
+            def fn_lim(p, m, bx, by, bw, limit, theta=None, alpha=None):
+                xs = (bx.swapaxes(0, 1), by.swapaxes(0, 1),
+                      bw.swapaxes(0, 1), jnp.arange(bx.shape[1]))
+
+                def step(carry, batch):
+                    p_, m_ = carry
+                    x, y, w, i = batch
+                    p2, m2, lw, aw = core(p_, m_, x, y, w, theta, alpha)
+                    g = i < limit
+                    return (_gate_tree(g, p2, p_),
+                            _gate_tree(g, m2, m_)), (lw, aw)
+
+                (p, m), (losses, accs) = jax.lax.scan(step, (p, m), xs)
+                return p, m, losses.swapaxes(0, 1), accs.swapaxes(0, 1)
+
+            return _arity_wrap(algorithm, fn_lim)
 
         def fn(p, m, bx, by, bw, theta=None, alpha=None):
             xs = (bx.swapaxes(0, 1), by.swapaxes(0, 1), bw.swapaxes(0, 1))
@@ -235,7 +299,23 @@ def make_stacked_local_update(apply_fn, *, lr, momentum, algorithm="sgd",
         return _arity_wrap(algorithm, fn)
     fn = make_local_update(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
-                           update_impl=update_impl, clip_norm=clip_norm)
+                           update_impl=update_impl, clip_norm=clip_norm,
+                           with_limit=with_limit)
+    if with_limit:
+        if algorithm == "sgd":
+            return jax.vmap(
+                lambda p, m, bx, by, bw, lim: fn(p, m, bx, by, bw, lim))
+        if algorithm == "fedprox":
+            return jax.vmap(
+                lambda p, m, bx, by, bw, lim, theta: fn(
+                    p, m, bx, by, bw, lim, theta=theta),
+                in_axes=(0, 0, 0, 0, 0, 0, None),
+            )
+        return jax.vmap(
+            lambda p, m, bx, by, bw, lim, theta, alpha: fn(
+                p, m, bx, by, bw, lim, theta=theta, alpha=alpha),
+            in_axes=(0, 0, 0, 0, 0, 0, None, 0),
+        )
     if algorithm == "sgd":
         return jax.vmap(lambda p, m, bx, by, bw: fn(p, m, bx, by, bw))
     if algorithm == "fedprox":
@@ -299,30 +379,44 @@ def pick_gather_chunks(steps: int, *, workers: int, batch: int,
 
 
 def _scan_steps_gathered(core, params, mom, idx, bw, train_x, train_y,
-                         theta, alpha, gather_chunks):
+                         theta, alpha, gather_chunks, limit=None):
     """Scan SGD steps over a [S, B] index plan against the resident train
     arrays.  ``gather_chunks=None`` gathers each minibatch inside the
     step body (O(B·|x|) live memory, one small gather per step);
     ``gather_chunks=k`` splits S into k chunks and materialises each
     chunk's batches with ONE big gather (O((S/k)·B·|x|) live memory) —
     same indices, same order, bit-identical numerics, far less per-step
-    gather overhead."""
+    gather overhead.  ``limit`` (straggler deadline) carries a step
+    counter and freezes params/momentum from step ``limit`` on."""
+
+    gated = limit is not None
 
     def step(carry, batch):
+        if gated:
+            p, m, k = carry
+            x, y, w = batch
+            p2, m2, loss, acc = core(p, m, x, y, w, theta, alpha)
+            g = k < limit
+            return (_gate_tree(g, p2, p), _gate_tree(g, m2, m),
+                    k + 1), (loss, acc)
         p, m = carry
         x, y, w = batch
         p, m, loss, acc = core(p, m, x, y, w, theta, alpha)
         return (p, m), (loss, acc)
 
+    carry0 = ((params, mom, jnp.zeros((), jnp.int32)) if gated
+              else (params, mom))
+
+    def strip(carry):
+        return carry[:2] if gated else carry
+
     if gather_chunks is None:
         def gstep(carry, batch):
-            p, m = carry
             i, w = batch
-            p, m, loss, acc = core(p, m, train_x[i], train_y[i], w,
-                                   theta, alpha)
-            return (p, m), (loss, acc)
+            return step(carry, (train_x[i], train_y[i], w))
 
-        return jax.lax.scan(gstep, (params, mom), (idx, bw))
+        carry, out = jax.lax.scan(gstep, carry0, (idx, bw))
+        return strip(carry), out
 
     s, b = idx.shape
     if s % gather_chunks:
@@ -335,8 +429,8 @@ def _scan_steps_gathered(core, params, mom, idx, bw, train_x, train_y,
         ci, cw = ch
         return jax.lax.scan(step, carry, (train_x[ci], train_y[ci], cw))
 
-    carry, (losses, accs) = jax.lax.scan(chunk, (params, mom), (idx_c, bw_c))
-    return carry, (losses.reshape(s), accs.reshape(s))
+    carry, (losses, accs) = jax.lax.scan(chunk, carry0, (idx_c, bw_c))
+    return strip(carry), (losses.reshape(s), accs.reshape(s))
 
 
 def make_local_update_gather(
@@ -350,6 +444,7 @@ def make_local_update_gather(
     update_impl: str = "jnp",
     gather_chunks: int | None = None,
     clip_norm: float = 0.0,
+    with_limit: bool = False,
 ):
     """Like ``make_local_update`` but gathers minibatches from the full
     on-device dataset inside the scan: the caller passes the [S, B]
@@ -360,13 +455,25 @@ def make_local_update_gather(
     multi-round block path keep K rounds of plans on device at once.
 
     Returns fn(params, mom, idx, bw, train_x, train_y, theta=None,
-    alpha=None) -> (new_params, new_mom, losses[S], accs[S]).
+    alpha=None) -> (new_params, new_mom, losses[S], accs[S]); with
+    ``with_limit=True`` the straggler step budget rides after ``bw``:
+    fn(params, mom, idx, bw, limit, train_x, train_y, ...).
     """
     if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
     core = _make_step_core(apply_fn, lr=lr, momentum=momentum,
                            algorithm=algorithm, rho=rho, l2=l2,
                            update_impl=update_impl, clip_norm=clip_norm)
+
+    if with_limit:
+        def local_update_lim(params, mom, idx, bw, limit, train_x, train_y,
+                             theta=None, alpha=None):
+            (params, mom), (losses, accs) = _scan_steps_gathered(
+                core, params, mom, idx, bw, train_x, train_y, theta, alpha,
+                gather_chunks, limit=limit)
+            return params, mom, losses, accs
+
+        return local_update_lim
 
     def local_update(params, mom, idx, bw, train_x, train_y,
                      theta=None, alpha=None):
@@ -379,31 +486,45 @@ def make_local_update_gather(
 
 
 def _scan_steps_gathered_stacked(core, params, mom, idx, bw, train_x,
-                                 train_y, theta, alpha, gather_chunks):
+                                 train_y, theta, alpha, gather_chunks,
+                                 limit=None):
     """Stacked-core twin of ``_scan_steps_gathered``: ``idx``/``bw`` are
     [W, S, B]; the scan runs S-major and each step consumes the full
-    [W, B, ...] slab.  Returns per-worker [W, S] loss/acc grids."""
+    [W, B, ...] slab.  Returns per-worker [W, S] loss/acc grids.
+    ``limit`` ([W] ints, straggler deadline) freezes worker w's lanes
+    from step limit[w] on."""
     idx_s = idx.swapaxes(0, 1)   # [S, W, B]
     bw_s = bw.swapaxes(0, 1)
+    gated = limit is not None
 
     def step(carry, batch):
+        if gated:
+            p, m, k = carry
+            x, y, w = batch
+            p2, m2, lw, aw = core(p, m, x, y, w, theta, alpha)
+            g = k < limit      # [W] bool
+            return (_gate_tree(g, p2, p), _gate_tree(g, m2, m),
+                    k + 1), (lw, aw)
         p, m = carry
         x, y, w = batch
         p, m, lw, aw = core(p, m, x, y, w, theta, alpha)
         return (p, m), (lw, aw)
 
+    carry0 = ((params, mom, jnp.zeros((), jnp.int32)) if gated
+              else (params, mom))
+
+    def strip(carry):
+        return carry[:2] if gated else carry
+
     if gather_chunks is None:
         def gstep(carry, batch):
-            p, m = carry
             i, w = batch
-            p, m, lw, aw = core(p, m, train_x[i], train_y[i], w,
-                                theta, alpha)
-            return (p, m), (lw, aw)
+            return step(carry, (train_x[i], train_y[i], w))
 
-        carry, (losses, accs) = jax.lax.scan(gstep, (params, mom),
+        carry, (losses, accs) = jax.lax.scan(gstep, carry0,
                                              (idx_s, bw_s),
                                              unroll=_SCAN_UNROLL)
-        return carry, (losses.swapaxes(0, 1), accs.swapaxes(0, 1))
+        return strip(carry), (losses.swapaxes(0, 1), accs.swapaxes(0, 1))
 
     s = idx_s.shape[0]
     if s % gather_chunks:
@@ -417,25 +538,36 @@ def _scan_steps_gathered_stacked(core, params, mom, idx, bw, train_x,
         return jax.lax.scan(step, carry, (train_x[ci], train_y[ci], cw),
                             unroll=_SCAN_UNROLL)
 
-    carry, (losses, accs) = jax.lax.scan(chunk, (params, mom), (idx_c, bw_c))
+    carry, (losses, accs) = jax.lax.scan(chunk, carry0, (idx_c, bw_c))
     w_ = idx.shape[0]
-    return carry, (losses.reshape(s, w_).swapaxes(0, 1),
-                   accs.reshape(s, w_).swapaxes(0, 1))
+    return strip(carry), (losses.reshape(s, w_).swapaxes(0, 1),
+                          accs.reshape(s, w_).swapaxes(0, 1))
 
 
 def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
                                      update_impl="jnp",
                                      gather_chunks=None,
-                                     stacked_apply=None, clip_norm=0.0):
+                                     stacked_apply=None, clip_norm=0.0,
+                                     with_limit=False):
     """vmap the gather-variant over the leading worker axis; train arrays
     and theta broadcast, ADMM duals stacked per worker.  With
     ``stacked_apply`` set, the grouped-conv stacked path replaces the
-    vmap (see ``make_stacked_local_update``)."""
+    vmap (see ``make_stacked_local_update``).  ``with_limit=True``: a
+    [W] straggler step budget rides after ``bw``."""
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
             rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
+
+        if with_limit:
+            def fn_lim(p, m, idx, bw, limit, tx, ty, theta=None, alpha=None):
+                (p, m), (losses, accs) = _scan_steps_gathered_stacked(
+                    core, p, m, idx, bw, tx, ty, theta, alpha,
+                    gather_chunks, limit=limit)
+                return p, m, losses, accs
+
+            return _arity_wrap(algorithm, fn_lim)
 
         def fn(p, m, idx, bw, tx, ty, theta=None, alpha=None):
             (p, m), (losses, accs) = _scan_steps_gathered_stacked(
@@ -447,7 +579,26 @@ def make_stacked_local_update_gather(apply_fn, *, lr, momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
                                   update_impl=update_impl,
                                   gather_chunks=gather_chunks,
-                                  clip_norm=clip_norm)
+                                  clip_norm=clip_norm,
+                                  with_limit=with_limit)
+    if with_limit:
+        if algorithm == "sgd":
+            return jax.vmap(
+                lambda p, m, idx, bw, lim, tx, ty: fn(
+                    p, m, idx, bw, lim, tx, ty),
+                in_axes=(0, 0, 0, 0, 0, None, None),
+            )
+        if algorithm == "fedprox":
+            return jax.vmap(
+                lambda p, m, idx, bw, lim, tx, ty, theta: fn(
+                    p, m, idx, bw, lim, tx, ty, theta=theta),
+                in_axes=(0, 0, 0, 0, 0, None, None, None),
+            )
+        return jax.vmap(
+            lambda p, m, idx, bw, lim, tx, ty, theta, alpha: fn(
+                p, m, idx, bw, lim, tx, ty, theta=theta, alpha=alpha),
+            in_axes=(0, 0, 0, 0, 0, None, None, None, 0),
+        )
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty: fn(p, m, idx, bw, tx, ty),
@@ -477,6 +628,7 @@ def make_local_update_epochs(
     update_impl: str = "jnp",
     gather_chunks: int | None = None,
     clip_norm: float = 0.0,
+    with_limit: bool = False,
 ):
     """Local update with the reference's EPOCH structure: an outer scan
     over local epochs, each running its steps then evaluating the
@@ -497,6 +649,12 @@ def make_local_update_epochs(
     * val_acc / val_loss_sum / val_loss_mean — post-epoch local-val
       metrics in both reference flavours (P1 ``inference`` sums batch
       losses, P2 averages them).
+
+    ``with_limit=True`` builds the straggler-deadline variant: an EPOCH
+    budget rides after ``bw`` — fn(params, mom, idx, bw, limit,
+    train_x, train_y, vidx, vw, ...) — and epochs e >= limit leave
+    params/momentum frozen (their em rows then reflect the frozen
+    params: the straggler's val metrics stop moving at its deadline).
     """
     if algorithm not in ("sgd", "fedprox", "fedadmm", "scaffold"):
         raise ValueError(f"unknown local algorithm {algorithm!r}")
@@ -504,6 +662,85 @@ def make_local_update_epochs(
                            algorithm=algorithm, rho=rho, l2=l2,
                            update_impl=update_impl, clip_norm=clip_norm)
     ev = make_evaluator(apply_fn)
+
+    def _epoch_steps(p, m, ei, ew, train_x, train_y, theta, alpha):
+        """One epoch's SGD steps: returns ((p, m), (losses, corrects,
+        counts)) — shared by the unlimited and straggler-gated variants
+        so their inner numerics can never diverge."""
+
+        def step(c, b):
+            p_, m_ = c
+            i, w_ = b
+            p_, m_, loss, acc = core(p_, m_, train_x[i], train_y[i], w_,
+                                     theta, alpha)
+            return (p_, m_), (loss, acc * w_.sum(), w_.sum())
+
+        def stepm(c, b):
+            p_, m_ = c
+            x, y, w_ = b
+            p_, m_, loss, acc = core(p_, m_, x, y, w_, theta, alpha)
+            return (p_, m_), (loss, acc * w_.sum(), w_.sum())
+
+        if gather_chunks is None:
+            return jax.lax.scan(step, (p, m), (ei, ew))
+        # Chunked big-gather within the epoch: same indices, same
+        # order, one slab gather per chunk instead of one small
+        # gather per step (see _scan_steps_gathered).
+        se, bsz = ei.shape
+        if se % gather_chunks:
+            raise ValueError(
+                f"gather_chunks={gather_chunks} does not divide "
+                f"steps/epoch={se}")
+        ei_c = ei.reshape(gather_chunks, se // gather_chunks, bsz)
+        ew_c = ew.reshape(ei_c.shape)
+
+        def chunk(c, ch):
+            ci, cw = ch
+            return jax.lax.scan(stepm, c, (train_x[ci], train_y[ci], cw))
+
+        (p, m), (losses, corrects, counts) = jax.lax.scan(
+            chunk, (p, m), (ei_c, ew_c))
+        return (p, m), (losses.reshape(se), corrects.reshape(se),
+                        counts.reshape(se))
+
+    if with_limit:
+        def local_update_lim(params, mom, idx, bw, limit, train_x, train_y,
+                             vidx, vw, theta=None, alpha=None):
+            # The unlimited epoch body with each epoch's carry gated:
+            # identical inner numerics, and the single post-epoch val
+            # eval sees the GATED params (a frozen straggler's val
+            # metrics reflect its frozen model).
+            vx = train_x[vidx]
+            vy = train_y[vidx]
+
+            def epoch(carry, ep):
+                p, m = carry
+                ei, ew, e = ep
+                (p2, m2), (losses, corrects, counts) = _epoch_steps(
+                    p, m, ei, ew, train_x, train_y, theta, alpha)
+                g = e < limit
+                p = _gate_tree(g, p2, p)
+                m = _gate_tree(g, m2, m)
+                # Train metrics for skipped epochs report 0 (the worker
+                # did no work — the fault ledger records the truncation).
+                vm = ev(p, vx, vy, vw)
+                em = {
+                    "train_loss": jnp.where(g, losses.mean(), 0.0),
+                    "train_acc": jnp.where(
+                        g, corrects.sum() / jnp.maximum(counts.sum(), 1.0),
+                        0.0),
+                    "val_acc": vm["acc"],
+                    "val_loss_sum": vm["loss_sum"],
+                    "val_loss_mean": vm["loss_mean"],
+                }
+                return (p, m), em
+
+            (params, mom), em = jax.lax.scan(
+                epoch, (params, mom),
+                (idx, bw, jnp.arange(idx.shape[0])))
+            return params, mom, em
+
+        return local_update_lim
 
     def local_update(params, mom, idx, bw, train_x, train_y, vidx, vw,
                      theta=None, alpha=None):
@@ -513,45 +750,8 @@ def make_local_update_epochs(
         def epoch(carry, ep):
             p, m = carry
             ei, ew = ep
-
-            def step(c, b):
-                p_, m_ = c
-                i, w_ = b
-                p_, m_, loss, acc = core(p_, m_, train_x[i], train_y[i], w_,
-                                         theta, alpha)
-                return (p_, m_), (loss, acc * w_.sum(), w_.sum())
-
-            def stepm(c, b):
-                p_, m_ = c
-                x, y, w_ = b
-                p_, m_, loss, acc = core(p_, m_, x, y, w_, theta, alpha)
-                return (p_, m_), (loss, acc * w_.sum(), w_.sum())
-
-            if gather_chunks is None:
-                (p, m), (losses, corrects, counts) = jax.lax.scan(
-                    step, (p, m), (ei, ew))
-            else:
-                # Chunked big-gather within the epoch: same indices, same
-                # order, one slab gather per chunk instead of one small
-                # gather per step (see _scan_steps_gathered).
-                se, bsz = ei.shape
-                if se % gather_chunks:
-                    raise ValueError(
-                        f"gather_chunks={gather_chunks} does not divide "
-                        f"steps/epoch={se}")
-                ei_c = ei.reshape(gather_chunks, se // gather_chunks, bsz)
-                ew_c = ew.reshape(ei_c.shape)
-
-                def chunk(c, ch):
-                    ci, cw = ch
-                    return jax.lax.scan(
-                        stepm, c, (train_x[ci], train_y[ci], cw))
-
-                (p, m), (losses, corrects, counts) = jax.lax.scan(
-                    chunk, (p, m), (ei_c, ew_c))
-                losses = losses.reshape(se)
-                corrects = corrects.reshape(se)
-                counts = counts.reshape(se)
+            (p, m), (losses, corrects, counts) = _epoch_steps(
+                p, m, ei, ew, train_x, train_y, theta, alpha)
             vm = ev(p, vx, vy, vw)
             em = {
                 "train_loss": losses.mean(),
@@ -590,15 +790,57 @@ def _stacked_eval_scan(stacked_apply, params, ex, ey, ew):
 def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
                                      algorithm="sgd", rho=0.0, l2=0.0,
                                      update_impl="jnp", gather_chunks=None,
-                                     stacked_apply=None, clip_norm=0.0):
+                                     stacked_apply=None, clip_norm=0.0,
+                                     with_limit=False):
     """vmap the epoch-structured update over the leading worker axis;
     train arrays and theta broadcast, per-worker plans / val stacks /
     ADMM duals stacked.  With ``stacked_apply`` set, the grouped-conv
-    stacked path replaces the vmap (see ``make_stacked_local_update``)."""
+    stacked path replaces the vmap (see ``make_stacked_local_update``).
+    ``with_limit=True``: a [W] straggler EPOCH budget rides after
+    ``bw`` (see ``make_local_update_epochs``)."""
     if stacked_apply is not None:
         core = _make_stacked_step_core(
             stacked_apply, lr=lr, momentum=momentum, algorithm=algorithm,
             rho=rho, l2=l2, update_impl=update_impl, clip_norm=clip_norm)
+
+        if with_limit:
+            def fn_lim(p, m, idx, bw, elimit, tx, ty, vi, vw_,
+                       theta=None, alpha=None):
+                vi_s = vi.swapaxes(0, 1)
+                vw_s = vw_.swapaxes(0, 1)
+                vx, vy = tx[vi_s], ty[vi_s]
+                idx_e = idx.swapaxes(0, 1)
+                bw_e = bw.swapaxes(0, 1)
+
+                def epoch(carry, ep):
+                    p_, m_ = carry
+                    ei, ew, e = ep
+                    (p2, m2), (lws, aws) = _scan_steps_gathered_stacked(
+                        core, p_, m_, ei, ew, tx, ty, theta, alpha,
+                        gather_chunks)
+                    g = e < elimit          # [W] bool epoch gate
+                    p_ = _gate_tree(g, p2, p_)
+                    m_ = _gate_tree(g, m2, m_)
+                    counts = ew.sum(axis=-1)
+                    vm = _stacked_eval_scan(stacked_apply, p_, vx, vy, vw_s)
+                    em = {
+                        "train_loss": jnp.where(g, lws.mean(axis=1), 0.0),
+                        "train_acc": jnp.where(
+                            g, (aws * counts).sum(axis=1)
+                            / jnp.maximum(counts.sum(axis=1), 1.0), 0.0),
+                        "val_acc": vm["acc"],
+                        "val_loss_sum": vm["loss_sum"],
+                        "val_loss_mean": vm["loss_mean"],
+                    }
+                    return (p_, m_), em
+
+                (p, m), em = jax.lax.scan(
+                    epoch, (p, m),
+                    (idx_e, bw_e, jnp.arange(idx_e.shape[0])))
+                em = {k: v.swapaxes(0, 1) for k, v in em.items()}  # [W, E]
+                return p, m, em
+
+            return _arity_wrap(algorithm, fn_lim)
 
         def fn(p, m, idx, bw, tx, ty, vi, vw_, theta=None, alpha=None):
             vi_s = vi.swapaxes(0, 1)        # [Sv, W, Bv]
@@ -634,7 +876,27 @@ def make_stacked_local_update_epochs(apply_fn, *, lr, momentum,
                                   algorithm=algorithm, rho=rho, l2=l2,
                                   update_impl=update_impl,
                                   gather_chunks=gather_chunks,
-                                  clip_norm=clip_norm)
+                                  clip_norm=clip_norm,
+                                  with_limit=with_limit)
+    if with_limit:
+        if algorithm == "sgd":
+            return jax.vmap(
+                lambda p, m, idx, bw, lim, tx, ty, vi, vw_: fn(
+                    p, m, idx, bw, lim, tx, ty, vi, vw_),
+                in_axes=(0, 0, 0, 0, 0, None, None, 0, 0),
+            )
+        if algorithm == "fedprox":
+            return jax.vmap(
+                lambda p, m, idx, bw, lim, tx, ty, vi, vw_, theta: fn(
+                    p, m, idx, bw, lim, tx, ty, vi, vw_, theta=theta),
+                in_axes=(0, 0, 0, 0, 0, None, None, 0, 0, None),
+            )
+        return jax.vmap(
+            lambda p, m, idx, bw, lim, tx, ty, vi, vw_, theta, alpha: fn(
+                p, m, idx, bw, lim, tx, ty, vi, vw_, theta=theta,
+                alpha=alpha),
+            in_axes=(0, 0, 0, 0, 0, None, None, 0, 0, None, 0),
+        )
     if algorithm == "sgd":
         return jax.vmap(
             lambda p, m, idx, bw, tx, ty, vi, vw_: fn(p, m, idx, bw, tx, ty,

@@ -1,0 +1,229 @@
+"""Unified fault injection & recovery (``FaultPlan``).
+
+The reference has no failure handling at all (SURVEY §5): every
+simulated worker is assumed alive and instant.  Production-scale
+decentralized training treats crashes, stragglers and partitions as the
+steady state ("From promise to practice", arXiv:2410.11998; FusionLLM,
+arXiv:2410.12707).  This module is the single source of truth for what
+fails when:
+
+* **Crashes** — a worker is down for the round.  Gossip: it skips
+  consensus and local training (its mixing row is repaired to identity,
+  its lane frozen via ``where_mask``) and rejoins next round with stale
+  state.  Federated: it contributes nothing to the server aggregate.
+* **Stragglers** — a deadline model: slow workers finish only
+  ``straggle_frac`` of their local epochs/steps (the engines gate the
+  SGD scan per worker, ``dopt.engine.local``), or — federated with
+  ``straggler_policy='drop'`` — are dropped by the server deadline,
+  with optional over-selection so the aggregate still averages ~m
+  clients (the FedAvg-paper pattern).
+* **Partitions** — the fleet splits into random groups for a span of
+  rounds.  Gossip: cross-group mixing edges are cut and the matrix
+  repaired as data (``dopt.topology.repair_for_partition``).
+  Federated: only group 0 can reach the server.
+
+Every draw is keyed **statelessly** by (seed, kind, round) — no RNG
+state is carried between rounds — which is what makes fault traces
+(a) identical between per-round and fused-block execution, (b) exactly
+replayable from the config alone, and (c) crash-exact under
+checkpoint/resume: a run killed at round t and resumed sees precisely
+the faults a continuous run would.
+
+Every injected fault is recorded in the run's **fault ledger**
+(``dopt.utils.metrics.History.faults``): one row per (round, worker,
+kind, action taken), checkpointed with the rest of the training state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import numpy as np
+
+from dopt.config import FaultConfig
+from dopt.utils.prng import host_rng
+
+# Salt namespace for the fault streams (distinct from the engines'
+# sampling/matching salts so enabling faults never perturbs them).
+_FAULT_SALT = 0xFA010
+_CRASH, _STRAGGLE, _PARTITION = 1, 2, 3
+
+KINDS = ("crash", "straggler", "partition", "overselect")
+
+
+@dataclass(frozen=True)
+class RoundFaults:
+    """One round's fault state, as plain host arrays.
+
+    ``crashed``/``straggler`` are bool [W]; ``epoch_frac`` is float32
+    [W] (1.0 for healthy workers, ``straggle_frac`` for stragglers);
+    ``partition`` is an int32 [W] group-id vector, or None when no
+    partition is active this round."""
+
+    round: int
+    crashed: np.ndarray
+    straggler: np.ndarray
+    epoch_frac: np.ndarray
+    partition: np.ndarray | None
+
+    @property
+    def any_fault(self) -> bool:
+        return (bool(self.crashed.any()) or bool(self.straggler.any())
+                or self.partition is not None)
+
+
+class FaultPlan:
+    """Deterministic per-round fault-trace generator for one fleet.
+
+    ``cfg=None`` (with ``dropout=0``) is the explicit fault-free plan:
+    ``for_round`` returns all-alive states and the engines compile the
+    exact pre-fault program.  ``dropout`` is the back-compat alias for
+    ``GossipConfig.dropout`` — it synthesizes ``FaultConfig(crash=p)``.
+    """
+
+    def __init__(self, num_workers: int, cfg: FaultConfig | None = None, *,
+                 seed: int = 0, dropout: float = 0.0):
+        if cfg is not None and dropout > 0.0:
+            raise ValueError(
+                "set faults via FaultConfig OR the legacy "
+                "GossipConfig.dropout alias, not both")
+        if cfg is None and dropout > 0.0:
+            cfg = FaultConfig(crash=float(dropout))
+        if cfg is not None:
+            validate_fault_config(cfg)
+        self.cfg = cfg
+        self.num_workers = int(num_workers)
+        self.seed = (int(cfg.seed) if cfg is not None and cfg.seed is not None
+                     else int(seed))
+
+    # -- capability flags (engines key compiled-program shape on these,
+    # -- so the fault-free path stays bit-identical to the pre-fault one)
+    @property
+    def active(self) -> bool:
+        c = self.cfg
+        return c is not None and (c.crash > 0 or c.straggle > 0
+                                  or c.partition > 0)
+
+    @property
+    def may_straggle(self) -> bool:
+        return self.active and self.cfg.straggle > 0
+
+    @property
+    def affects_matrix(self) -> bool:
+        """Crash or partition repair can add identity rows to the mixing
+        matrix (the shift path must compile shift 0 into its set)."""
+        return self.active and (self.cfg.crash > 0 or self.cfg.partition > 0)
+
+    # ------------------------------------------------------------------
+    def _rng(self, kind: int, t: int) -> np.random.Generator:
+        return host_rng(self.seed, _FAULT_SALT, kind, int(t))
+
+    def for_round(self, t: int) -> RoundFaults:
+        w = self.num_workers
+        none = np.zeros(w, bool)
+        if not self.active:
+            return RoundFaults(int(t), none, none, np.ones(w, np.float32),
+                               None)
+        c = self.cfg
+        crashed = (self._rng(_CRASH, t).random(w) < c.crash
+                   if c.crash > 0 else none)
+        straggler = (self._rng(_STRAGGLE, t).random(w) < c.straggle
+                     if c.straggle > 0 else none)
+        straggler = straggler & ~crashed   # a crashed worker cannot straggle
+        frac = np.where(straggler, np.float32(c.straggle_frac),
+                        np.float32(1.0)).astype(np.float32)
+        return RoundFaults(int(t), crashed, straggler, frac,
+                           self._partition_for_round(t))
+
+    def _partition_for_round(self, t: int) -> np.ndarray | None:
+        """Partition active at t ⇔ one started at some s ∈ (t−span, t];
+        the most recent start wins.  Start draws and group assignments
+        are keyed by the START round, so a partition's membership is
+        stable over its whole span."""
+        c = self.cfg
+        if c is None or c.partition <= 0:
+            return None
+        for s in range(int(t), max(int(t) - c.partition_span, -1), -1):
+            r = self._rng(_PARTITION, s)
+            if r.random() < c.partition:
+                groups = r.integers(0, c.partition_groups,
+                                    size=self.num_workers)
+                return groups.astype(np.int32)
+        return None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def limits_for(rf: RoundFaults, total_units: int) -> np.ndarray:
+        """Per-worker work limits in the engine's granularity (epochs
+        under the holdout's epoch loop, SGD steps on the flat path):
+        healthy workers get ``total_units``, stragglers
+        ``ceil(frac · total_units)`` (≥ 1 for frac > 0)."""
+        lim = np.ceil(rf.epoch_frac * float(total_units))
+        return np.clip(lim, 0, total_units).astype(np.int32)
+
+
+def validate_fault_config(cfg: FaultConfig) -> None:
+    """Range/enum checks shared by ``FaultPlan`` and the CLI parser (so
+    a bad ``--faults`` value fails at parse time with a clean message,
+    not as a traceback from trainer construction)."""
+    for f in ("crash", "straggle", "partition"):
+        v = getattr(cfg, f)
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(f"FaultConfig.{f}={v} must be in [0, 1]")
+    if not 0.0 <= cfg.straggle_frac <= 1.0:
+        raise ValueError(
+            f"FaultConfig.straggle_frac={cfg.straggle_frac} must be "
+            "in [0, 1]")
+    if cfg.straggle > 0 and cfg.straggle_frac <= 0.0:
+        # A zero-step straggler would leave p_t == theta, which corrupts
+        # SCAFFOLD's control refresh (c_i drifts by -c_global every time
+        # the worker is sampled).  Zero work for the round IS a crash —
+        # model it with `crash` instead.
+        raise ValueError(
+            "FaultConfig.straggle_frac must be > 0 when straggle > 0 "
+            "(a straggler always finishes SOME work; use crash for "
+            "workers that do none)")
+    if cfg.straggler_policy not in ("partial", "drop"):
+        raise ValueError(
+            f"unknown straggler_policy {cfg.straggler_policy!r}; "
+            "one of partial|drop")
+    if cfg.over_select < 0.0:
+        raise ValueError("FaultConfig.over_select must be >= 0")
+    if cfg.partition_span < 1:
+        raise ValueError("FaultConfig.partition_span must be >= 1")
+    if cfg.partition_groups < 2:
+        raise ValueError("FaultConfig.partition_groups must be >= 2")
+
+
+def parse_fault_spec(spec: str) -> FaultConfig:
+    """CLI ``--faults`` spec → FaultConfig.
+
+    e.g. ``--faults "crash=0.1,straggle=0.2,straggle_frac=0.5,partition=0.05"``
+    — keys are FaultConfig field names, values coerced to the field's
+    annotated type, unknown keys rejected loudly."""
+    fields = {f.name: f for f in dataclasses.fields(FaultConfig)}
+    kw: dict[str, object] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, raw = part.partition("=")
+        key = key.strip()
+        if not eq or key not in fields:
+            raise ValueError(
+                f"--faults: unknown field {key!r}; one of {sorted(fields)}")
+        ann = str(fields[key].type)
+        try:
+            if ann.startswith("int"):
+                kw[key] = int(raw)
+            elif ann.startswith("float"):
+                kw[key] = float(raw)
+            else:
+                kw[key] = raw.strip()
+        except ValueError:
+            raise ValueError(
+                f"--faults: field {key!r} expects {ann}, got {raw!r}")
+    cfg = FaultConfig(**kw)
+    validate_fault_config(cfg)
+    return cfg

@@ -117,7 +117,12 @@ def scaffold_control_update(c_local, c_global, theta, params, *,
     does this, and starts sampled workers from a zero momentum buffer so
     no stale-round momentum leaks into theta − y_i).
     """
-    scale = 1.0 / (lr * max(num_steps, 1))
+    if isinstance(num_steps, (int, float)):
+        scale = 1.0 / (lr * max(num_steps, 1))
+    else:
+        # Traced per-lane step counts (straggler fault injection: each
+        # lane refreshes with ITS executed step count).
+        scale = 1.0 / (lr * jnp.maximum(num_steps, 1).astype(jnp.float32))
     return jax.tree.map(
         lambda ci, c, t, y: ci - c + scale * (t - y),
         c_local, c_global, theta, params,

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dopt.parallel.mesh import WORKER_AXIS
+from dopt.parallel.mesh import WORKER_AXIS, compat_shard_map
 
 
 def mix_dense(stacked, w_matrix, mesh: Mesh | None = None,
@@ -90,8 +90,9 @@ def _mix_dense_compressed(stacked, w, mesh: Mesh, comm_dtype):
         return y.astype(xl.dtype)
 
     def mix_leaf(x):
-        fn = jax.shard_map(per_device, mesh=mesh,
-                           in_specs=(P(ax, None), P(ax)), out_specs=P(ax))
+        fn = compat_shard_map(per_device, mesh=mesh,
+                              in_specs=(P(ax, None), P(ax)),
+                              out_specs=P(ax))
         return fn(w, x)
 
     return jax.tree.map(mix_leaf, stacked)
@@ -219,7 +220,7 @@ def mix_shifts(stacked, shift_ids, coeff_table, mesh: Mesh, comm_dtype=None):
     coeff_specs = P(None, WORKER_AXIS)  # [k, n] -> coeffs sharded on worker axis
 
     def mix_leaf(x):
-        fn = jax.shard_map(
+        fn = compat_shard_map(
             per_device,
             mesh=mesh,
             in_specs=(coeff_specs, P(WORKER_AXIS)),
@@ -297,9 +298,9 @@ def _masked_average_compressed(stacked, m, denom, mesh: Mesh, comm_dtype):
         # all_gather+local-sum yields a value that IS replicated but
         # can't be statically proven so (unlike psum); skip the static
         # varying-axes check for this one collective.
-        fn = jax.shard_map(per_device, mesh=mesh,
-                           in_specs=(P(ax), P(ax)), out_specs=P(),
-                           check_vma=False)
+        fn = compat_shard_map(per_device, mesh=mesh,
+                              in_specs=(P(ax), P(ax)), out_specs=P(),
+                              check=False)
         return fn(m, x)
 
     return jax.tree.map(avg_leaf, stacked)

@@ -18,6 +18,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 WORKER_AXIS = "workers"
 
 
+def compat_shard_map(fn, *, mesh, in_specs, out_specs, check=True):
+    """``jax.shard_map`` across jax versions: new jax exposes it at the
+    top level with the static-varying-axes check named ``check_vma``;
+    0.4.x has it under ``jax.experimental.shard_map`` as ``check_rep``.
+    Every shard_map in dopt routes through here so the engines run on
+    both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+
+
 def make_mesh(num_devices: int | None = None, *, devices=None) -> Mesh:
     """1-D mesh over the worker axis."""
     if devices is None:
@@ -101,8 +116,8 @@ def shard_over_workers(fn, mesh: Mesh, in_specs, out_specs):
             return one(spec)
         return tuple(one(c) for c in spec)
 
-    return jax.shard_map(fn, mesh=mesh, in_specs=resolve(in_specs),
-                         out_specs=resolve(out_specs), check_vma=False)
+    return compat_shard_map(fn, mesh=mesh, in_specs=resolve(in_specs),
+                            out_specs=resolve(out_specs), check=False)
 
 
 def make_worker_mesh(num_workers: int, mesh_devices: int | None = None,

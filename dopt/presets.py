@@ -17,9 +17,11 @@ preset runs everywhere.
 
 from __future__ import annotations
 
-from dopt.config import (DataConfig, ExperimentConfig, FederatedConfig,
-                         GossipConfig, ModelConfig, OptimizerConfig,
-                         SeqLMConfig)
+import dataclasses
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig, SeqLMConfig)
 
 MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
 CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
@@ -225,6 +227,24 @@ PRESETS = {
     "baseline4": baseline_4_admm_a9a,
     "baseline5": baseline_5_gossip32_resnet,
     "seqlm": seqlm_ring,
+    # Fault-injection variants (dopt.faults.FaultPlan): the same
+    # workloads under a production-shaped failure regime — per-round
+    # client crashes, a straggler deadline finishing half the local
+    # work, and occasional 2-way network partitions.  The federated
+    # variant over-selects clients FedAvg-paper style so the aggregate
+    # still averages ~m survivors.  Tune any knob with
+    # --set faults.crash=... or replace wholesale with --faults.
+    "baseline3-faulty": lambda: dataclasses.replace(
+        baseline_3_fedavg_noniid(),
+        name="baseline3-fedavg16-noniid-faulty",
+        faults=FaultConfig(crash=0.1, straggle=0.2, straggle_frac=0.5,
+                           over_select=0.3, partition=0.05,
+                           partition_span=2)),
+    "baseline1-faulty": lambda: dataclasses.replace(
+        baseline_1_ring_mnist_mlp(),
+        name="baseline1-ring-mnist-mlp-faulty",
+        faults=FaultConfig(crash=0.1, straggle=0.2, straggle_frac=0.5,
+                           partition=0.05, partition_span=2)),
 }
 
 

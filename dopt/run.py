@@ -107,8 +107,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--csv", default=None, help="write history CSV here")
     ap.add_argument("--checkpoint", default=None,
                     help="save a checkpoint here after the run")
+    ap.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                    help="auto-checkpoint to --checkpoint every K rounds "
+                         "during the run (crash-exact: a run killed at any "
+                         "point and restarted with --resume is bit-identical "
+                         "to a continuous run); federated/gossip jax "
+                         "engines only")
     ap.add_argument("--resume", default=None,
-                    help="restore this checkpoint before running")
+                    help="restore this checkpoint before running (pair with "
+                         "--checkpoint-every for kill-and-resume workflows)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="inject deterministic faults "
+                         "(dopt.faults.FaultPlan): comma-separated "
+                         "FaultConfig fields, e.g. "
+                         "'crash=0.1,straggle=0.2,straggle_frac=0.5,"
+                         "partition=0.05'; every injected fault is recorded "
+                         "in the run's fault ledger")
+    ap.add_argument("--faults-json", default=None, metavar="PATH",
+                    help="write the run's fault ledger here as JSON")
     ap.add_argument("--timers", action="store_true",
                     help="print phase-timer report")
     ap.add_argument("--trace", default=None, metavar="DIR",
@@ -135,6 +151,20 @@ def main(argv: list[str] | None = None) -> int:
     cfg = get_preset(args.preset)
     for spec in args.overrides:
         cfg = apply_override(cfg, spec)
+    if args.faults:
+        from dopt.faults import parse_fault_spec
+
+        try:
+            cfg = cfg.replace(faults=parse_fault_spec(args.faults))
+        except ValueError as e:
+            raise SystemExit(str(e))
+    if cfg.faults is not None and (cfg.seqlm is not None
+                                   or cfg.backend == "torch"):
+        # The torch oracle and seqlm engines never read cfg.faults —
+        # reject loudly instead of running "fault-free" with an empty
+        # ledger the user believes is a faulted run.
+        raise SystemExit("fault injection is supported by the "
+                         "federated/gossip jax engines only")
     if args.num_users is not None:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data,
                                                    num_users=args.num_users))
@@ -164,14 +194,23 @@ def main(argv: list[str] | None = None) -> int:
             rounds = cfg.federated.rounds
         else:
             rounds = cfg.gossip.rounds
+    run_kw = {}
+    if args.checkpoint_every:
+        if not args.checkpoint:
+            raise SystemExit("--checkpoint-every requires --checkpoint PATH")
+        if cfg.seqlm is not None or cfg.backend == "torch":
+            raise SystemExit("--checkpoint-every is supported by the "
+                             "federated/gossip jax engines only")
+        run_kw = {"checkpoint_every": args.checkpoint_every,
+                  "checkpoint_path": args.checkpoint}
     if args.trace:
         from dopt.utils.profiling import trace
 
         with trace(args.trace):
-            trainer.run(rounds=rounds)
+            trainer.run(rounds=rounds, **run_kw)
         print(f"wrote XLA trace to {args.trace}", file=sys.stderr)
     else:
-        trainer.run(rounds=rounds)
+        trainer.run(rounds=rounds, **run_kw)
     for row in trainer.history.rows[-min(rounds, len(trainer.history)):]:
         print(json.dumps(row))
     print(f"total_time_s={trainer.total_time:.2f}", file=sys.stderr)
@@ -181,6 +220,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.csv:
         trainer.history.to_csv(args.csv)
         print(f"wrote {args.csv}", file=sys.stderr)
+    if getattr(trainer.history, "faults", None):
+        print(f"fault ledger: {len(trainer.history.faults)} entries",
+              file=sys.stderr)
+    if args.faults_json:
+        trainer.history.faults_to_json(args.faults_json)
+        print(f"wrote fault ledger to {args.faults_json}", file=sys.stderr)
     if args.checkpoint:
         trainer.save(args.checkpoint)
         print(f"checkpointed to {args.checkpoint}", file=sys.stderr)

@@ -447,11 +447,43 @@ def repair_for_dropout(w: np.ndarray, alive: np.ndarray) -> np.ndarray:
     """
     n = w.shape[0]
     a = np.asarray(alive, dtype=w.dtype).reshape(1, n)
-    masked = w * a                       # drop edges into dead workers
+    return _repair_edges(w, a, force_identity=np.asarray(alive) <= 0)
+
+
+def _repair_edges(w: np.ndarray, edge_mask: np.ndarray,
+                  force_identity: np.ndarray | None = None) -> np.ndarray:
+    """Shared healing core for dropout/partition repair: drop the
+    masked-out edges, renormalise surviving rows to stay stochastic,
+    and give isolated rows (no surviving out-edges, or explicitly
+    forced — dead workers) an exact identity row."""
+    masked = w * edge_mask
     rowsum = masked.sum(axis=1, keepdims=True)
     safe = np.where(rowsum > 0, rowsum, 1.0)
     repaired = masked / safe
-    isolated = np.nonzero((rowsum[:, 0] <= 0) | (np.asarray(alive) <= 0))[0]
+    iso = rowsum[:, 0] <= 0
+    if force_identity is not None:
+        iso = iso | force_identity
+    isolated = np.nonzero(iso)[0]
     repaired[isolated, :] = 0.0
     repaired[isolated, isolated] = 1.0
     return repaired
+
+
+def repair_for_partition(w: np.ndarray, groups: np.ndarray) -> np.ndarray:
+    """Rebuild a mixing matrix under a network partition: edges that
+    cross the cut are removed and surviving rows renormalised, exactly
+    the ``repair_for_dropout`` healing semantics applied edge-wise.
+
+    ``groups`` is an int vector of partition-side ids; only same-group
+    edges survive.  A worker isolated by the cut (all neighbors on the
+    other side) keeps its own weights for the span (identity row), so
+    every side keeps mixing internally and the fleet re-fuses when the
+    partition heals — the matrix is data, nothing is recompiled.
+    """
+    g = np.asarray(groups).reshape(-1)
+    n = w.shape[0]
+    if g.shape[0] != n:
+        raise ValueError(f"groups has {g.shape[0]} entries for an "
+                         f"{n}-worker matrix")
+    same = (g[:, None] == g[None, :]).astype(w.dtype)
+    return _repair_edges(w, same)

@@ -54,6 +54,23 @@ def _write_meta(dest: Path, meta: dict[str, Any]) -> None:
     (dest / "meta.json").write_text(json.dumps(meta, indent=2))
 
 
+# Completeness marker: written LAST into the staging dir, it records
+# every checkpoint file's size.  ``_is_complete`` cross-checks the
+# manifest against the files on disk, so a checkpoint truncated by a
+# mid-write crash (or a partial copy) is detected and rejected instead
+# of loaded as garbage.
+_MARKER = "complete.json"
+
+
+def _write_marker(dest: Path) -> None:
+    files = {
+        str(p.relative_to(dest)): p.stat().st_size
+        for p in sorted(dest.rglob("*"))
+        if p.is_file() and p.name != _MARKER
+    }
+    (dest / _MARKER).write_text(json.dumps(files, indent=2))
+
+
 def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
                     meta: dict[str, Any]) -> Path:
     """Save an arrays pytree (orbax) + JSON metadata, atomically.
@@ -74,6 +91,7 @@ def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
     tmp.mkdir(parents=True)
     _write_state(tmp, arrays)
     _write_meta(tmp, meta)
+    _write_marker(tmp)
 
     # Swap: park the previous checkpoint, promote the new one, then drop
     # the parked copy.  os.replace cannot overwrite a non-empty dir, so
@@ -95,7 +113,26 @@ def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
 def _is_complete(path: Path) -> bool:
     if not (path / "meta.json").exists():
         return False
-    return (path / "state").exists() or (path / "state.npz").exists()
+    if not ((path / "state").exists() or (path / "state.npz").exists()):
+        return False
+    marker = path / _MARKER
+    if not marker.exists():
+        # Pre-manifest checkpoint: only the presence check is possible.
+        return True
+    try:
+        manifest = json.loads(marker.read_text())
+    except ValueError:
+        return False
+    for rel, size in manifest.items():
+        f = path / rel
+        if not f.is_file() or f.stat().st_size != int(size):
+            return False
+    return True
+
+
+class IncompleteCheckpointError(RuntimeError):
+    """Neither the checkpoint nor its ``.old`` fallback is complete
+    (mid-write crash, truncation, or partial copy)."""
 
 
 def load_checkpoint(path: str | Path) -> tuple[dict[str, Any], dict[str, Any]]:
@@ -109,6 +146,13 @@ def load_checkpoint(path: str | Path) -> tuple[dict[str, Any], dict[str, Any]]:
         old = path.with_name(path.name + ".old")
         if _is_complete(old):
             path = old
+        else:
+            raise IncompleteCheckpointError(
+                f"checkpoint at {path} is missing, truncated, or "
+                "incomplete (its size manifest does not match the files "
+                f"on disk), and no complete fallback exists at {old}; "
+                "re-save from a live trainer or point at an earlier "
+                "checkpoint")
     meta = json.loads((path / "meta.json").read_text())
     if HAVE_ORBAX and (path / "state").exists():
         ckpt = ocp.PyTreeCheckpointer()

@@ -24,9 +24,27 @@ class History:
     def __init__(self, name: str = "history"):
         self.name = name
         self.rows: list[dict[str, Any]] = []
+        # Fault ledger (dopt.faults): one row per injected fault —
+        # {round, worker, kind, action} — so faulted runs are auditable
+        # and replayable.  Appended by the engines as faults are
+        # injected, checkpointed alongside ``rows``.
+        self.faults: list[dict[str, Any]] = []
 
     def append(self, **row: Any) -> None:
         self.rows.append({k: _scalar(v) for k, v in row.items()})
+
+    def log_fault(self, *, round: int, worker: int, kind: str,
+                  action: str) -> None:
+        """Record one injected fault in the ledger (schema: round,
+        worker, kind ∈ dopt.faults.KINDS, action taken)."""
+        self.faults.append({"round": int(round), "worker": int(worker),
+                            "kind": str(kind), "action": str(action)})
+
+    def faults_to_json(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.faults, indent=2))
+        return path
 
     def __len__(self) -> int:
         return len(self.rows)

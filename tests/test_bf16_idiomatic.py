@@ -89,6 +89,7 @@ def _idiomatic_cfg(**opt):
     )
 
 
+@pytest.mark.slow  # ~110s: 10 bf16 rounds; the heaviest single compile
 def test_idiomatic_bf16_trains_with_clip(devices):
     """Corrected-head Model1 in bf16 under clip reaches >=0.95 synthetic
     accuracy — the canary for the instability fixed in round 5 (without

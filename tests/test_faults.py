@@ -1,0 +1,402 @@
+"""Fault-injection & recovery subsystem tests (dopt.faults.FaultPlan).
+
+Three layers, all inside the tier-1 budget (tiny models, <= 4 rounds):
+
+* host-only FaultPlan semantics — stateless per-round draws, the
+  dropout back-compat alias, validation, the ``--faults`` CLI parser;
+* mixing-matrix repair properties (``repair_for_dropout`` /
+  ``repair_for_partition``) as seeded sweeps — the invariants every
+  engine path relies on (row-stochastic, identity rows for the
+  isolated/dead, all-down degenerates to identity) without a
+  hypothesis dependency;
+* engine integration — fault-free runs bit-identical to a no-faults
+  config, faulted runs deterministic with an auditable ledger,
+  compact/full-width parity under crashes, and crash-exact
+  checkpoint/resume for both engines.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig)
+from dopt.faults import KINDS, FaultPlan, RoundFaults, parse_fault_spec
+from dopt.topology import (build_mixing_matrices, repair_for_dropout,
+                           repair_for_partition)
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: stateless draws, aliasing, validation
+# ---------------------------------------------------------------------------
+
+def test_faultplan_stateless_and_order_independent():
+    cfg = FaultConfig(crash=0.3, straggle=0.4, straggle_frac=0.5,
+                      partition=0.3, partition_span=2)
+    a = FaultPlan(8, cfg, seed=11)
+    b = FaultPlan(8, cfg, seed=11)
+    # Draw rounds in different orders from different instances: traces
+    # must match exactly (this is what makes per-round and blocked
+    # execution — and killed-and-resumed runs — see identical faults).
+    for t in (5, 0, 3, 5):
+        ra, rb = a.for_round(t), b.for_round(t)
+        np.testing.assert_array_equal(ra.crashed, rb.crashed)
+        np.testing.assert_array_equal(ra.straggler, rb.straggler)
+        np.testing.assert_array_equal(ra.epoch_frac, rb.epoch_frac)
+        if ra.partition is None:
+            assert rb.partition is None
+        else:
+            np.testing.assert_array_equal(ra.partition, rb.partition)
+
+
+def test_faultplan_seeds_change_trace():
+    cfg = FaultConfig(crash=0.5)
+    a = FaultPlan(32, cfg, seed=1)
+    b = FaultPlan(32, cfg, seed=2)
+    assert any(
+        not np.array_equal(a.for_round(t).crashed, b.for_round(t).crashed)
+        for t in range(4))
+    # cfg.seed overrides the experiment seed
+    c = FaultPlan(32, dataclasses.replace(cfg, seed=1), seed=2)
+    for t in range(4):
+        np.testing.assert_array_equal(a.for_round(t).crashed,
+                                      c.for_round(t).crashed)
+
+
+def test_faultplan_inactive_and_fault_free():
+    for plan in (FaultPlan(6, None, seed=3),
+                 FaultPlan(6, FaultConfig(), seed=3)):
+        assert not plan.active and not plan.may_straggle
+        assert not plan.affects_matrix
+        rf = plan.for_round(9)
+        assert not rf.any_fault
+        assert not rf.crashed.any() and not rf.straggler.any()
+        np.testing.assert_array_equal(rf.epoch_frac, np.ones(6, np.float32))
+        assert rf.partition is None
+
+
+def test_faultplan_dropout_alias():
+    plan = FaultPlan(8, None, seed=5, dropout=0.25)
+    assert plan.active and plan.cfg.crash == 0.25
+    with pytest.raises(ValueError, match="not both"):
+        FaultPlan(8, FaultConfig(crash=0.1), seed=5, dropout=0.25)
+
+
+@pytest.mark.parametrize("bad", [
+    {"crash": 1.5}, {"straggle": -0.1}, {"straggle_frac": 2.0},
+    {"straggle": 0.5, "straggle_frac": 0.0},
+    {"straggler_policy": "retry"}, {"over_select": -1.0},
+    {"partition_span": 0}, {"partition_groups": 1},
+])
+def test_faultplan_validation(bad):
+    with pytest.raises(ValueError):
+        FaultPlan(8, FaultConfig(**bad), seed=0)
+
+
+def test_crash_wins_ties_and_limits():
+    cfg = FaultConfig(crash=1.0, straggle=1.0, straggle_frac=0.5)
+    rf = FaultPlan(8, cfg, seed=0).for_round(0)
+    assert rf.crashed.all() and not rf.straggler.any()
+    # limits: healthy workers get the full budget, stragglers
+    # ceil(frac * total) >= 1 for frac > 0
+    rf2 = RoundFaults(0, np.zeros(4, bool),
+                      np.array([False, True, True, True]),
+                      np.array([1.0, 0.5, 0.26, 0.01], np.float32), None)
+    np.testing.assert_array_equal(FaultPlan.limits_for(rf2, 4),
+                                  [4, 2, 2, 1])
+
+
+def test_partition_membership_stable_over_span():
+    cfg = FaultConfig(partition=0.4, partition_span=3, partition_groups=3)
+    plan = FaultPlan(10, cfg, seed=123)
+    # Find a start round: the draw keyed at s fires.
+    active = {t: plan.for_round(t).partition for t in range(40)}
+    starts = [t for t in range(40)
+              if active[t] is not None
+              and (t == 0 or active[t - 1] is None)]
+    assert starts, "expected at least one partition in 40 rounds"
+    for s in starts:
+        g = active[s]
+        assert g.min() >= 0 and g.max() < 3
+        # A start at s keeps SOME partition active for the whole span;
+        # membership keyed by the start round holds until a newer start
+        # supersedes it (the most recent start wins).
+        for t in range(s, min(s + 3, 40)):
+            assert active[t] is not None
+            newer_start = any(
+                FaultPlan(10, cfg, seed=123)._rng(3, u).random() < 0.4
+                for u in range(s + 1, t + 1))
+            if not newer_start:
+                np.testing.assert_array_equal(active[t], g)
+
+
+def test_parse_fault_spec():
+    cfg = parse_fault_spec(
+        "crash=0.1, straggle=0.2,straggle_frac=0.5,partition=0.05,"
+        "partition_span=3,straggler_policy=drop,over_select=0.3")
+    assert cfg.crash == 0.1 and cfg.straggle == 0.2
+    assert cfg.partition_span == 3 and cfg.straggler_policy == "drop"
+    assert cfg.over_select == 0.3
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_fault_spec("crush=0.1")
+    with pytest.raises(ValueError, match="expects"):
+        parse_fault_spec("crash=lots")
+    assert set(KINDS) == {"crash", "straggler", "partition", "overselect"}
+
+
+# ---------------------------------------------------------------------------
+# Mixing-matrix repair properties (seeded sweeps; hypothesis-free)
+# ---------------------------------------------------------------------------
+
+def _matrices(seed):
+    rng = np.random.default_rng(seed)
+    for topology, mode in (("circle", "metropolis"), ("complete", "uniform"),
+                           ("torus", "double_stochastic")):
+        n = int(rng.integers(4, 12))
+        yield (build_mixing_matrices(topology, mode, n, seed=seed)
+               .matrices[0], rng)
+
+
+def test_repair_for_dropout_properties():
+    for seed in range(8):
+        for w, rng in _matrices(seed):
+            n = w.shape[0]
+            alive = (rng.random(n) < 0.6).astype(np.float32)
+            r = repair_for_dropout(w, alive)
+            # every row stays stochastic; dead workers get EXACT
+            # identity rows (frozen, stale-but-valid rejoin)
+            np.testing.assert_allclose(r.sum(axis=1), 1.0, atol=1e-6)
+            for i in range(n):
+                if not alive[i]:
+                    expect = np.zeros(n); expect[i] = 1.0
+                    np.testing.assert_array_equal(r[i], expect)
+                else:
+                    assert np.all(r[i][alive == 0.0] == 0.0)
+
+
+def test_repair_for_dropout_all_down_is_identity():
+    for w, _ in _matrices(3):
+        n = w.shape[0]
+        r = repair_for_dropout(w, np.zeros(n, np.float32))
+        np.testing.assert_array_equal(r, np.eye(n))
+
+
+def test_repair_for_dropout_doubly_stochastic_symmetric_failures():
+    # A SYMMETRIC doubly-stochastic matrix under a failure pattern that
+    # isolates the survivors pairwise-symmetrically stays symmetric:
+    # masking w by outer(alive, alive) is symmetric, and the surviving
+    # rows' renormalisers are equal whenever their masked rows are
+    # permutations of each other.  The regular ring is the canonical
+    # case: any alive pattern keeps w masked symmetric, and rows
+    # renormalise by their own (equal-by-symmetry) sums only when the
+    # surviving neighbourhood is symmetric — assert the symmetric cases.
+    # Metropolis weights are the canonical SYMMETRIC doubly-stochastic
+    # construction (the 'double_stochastic' mode is doubly stochastic
+    # but directed).
+    mm = build_mixing_matrices("circle", "metropolis", 8, seed=0)
+    w = mm.matrices[0]
+    np.testing.assert_allclose(w, w.T, atol=1e-12)
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-6)
+    # Failure patterns that preserve the ring's symmetry group: all
+    # alive, alternating (every survivor isolated -> identity rows),
+    # and paired blocks (every survivor keeps exactly one neighbour
+    # with circulant-equal weights).
+    for alive in ([1, 1, 1, 1, 1, 1, 1, 1], [1, 0, 1, 0, 1, 0, 1, 0],
+                  [1, 1, 0, 0, 1, 1, 0, 0]):
+        a = np.asarray(alive, np.float32)
+        r = repair_for_dropout(w, a)
+        # symmetry of the repaired matrix over the alive-alive block
+        live = np.nonzero(a)[0]
+        sub = r[np.ix_(live, live)]
+        np.testing.assert_allclose(sub, sub.T, atol=1e-6)
+
+
+def test_repair_for_partition_properties():
+    for seed in range(8):
+        for w, rng in _matrices(seed):
+            n = w.shape[0]
+            groups = rng.integers(0, 2, size=n).astype(np.int32)
+            r = repair_for_partition(w, groups)
+            np.testing.assert_allclose(r.sum(axis=1), 1.0, atol=1e-6)
+            # no weight crosses the cut
+            cross = groups[:, None] != groups[None, :]
+            assert np.all(r[cross] == 0.0)
+            # a worker isolated by the cut keeps exactly its own weights
+            masked = w * (~cross).astype(w.dtype)
+            for i in np.nonzero(masked.sum(axis=1) <= 0)[0]:
+                expect = np.zeros(n); expect[i] = 1.0
+                np.testing.assert_array_equal(r[i], expect)
+    with pytest.raises(ValueError, match="entries"):
+        repair_for_partition(np.eye(4), np.zeros(3, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (tiny models, synthetic data)
+# ---------------------------------------------------------------------------
+
+_DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
+                   synthetic_train_size=256, synthetic_test_size=64)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5, rho=0.1)
+_FAULTS = FaultConfig(crash=0.3, straggle=0.3, straggle_frac=0.5)
+
+
+def _fed_cfg(faults=None, **fkw):
+    f = dict(algorithm="fedavg", frac=0.5, rounds=4, local_ep=1, local_bs=32)
+    f.update(fkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, federated=FederatedConfig(**f),
+                            faults=faults)
+
+
+def _gossip_cfg(faults=None, **gkw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=32)
+    g.update(gkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, gossip=GossipConfig(**g),
+                            faults=faults)
+
+
+def test_fault_free_runs_bit_identical(devices):
+    # No FaultPlan vs an all-zero FaultConfig: same History, empty
+    # ledger, and the sampling stream undisturbed — the acceptance
+    # criterion that enabling the subsystem never perturbs clean runs.
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    h0 = FederatedTrainer(_fed_cfg()).run(rounds=2)
+    h1 = FederatedTrainer(_fed_cfg(FaultConfig())).run(rounds=2)
+    assert h0.rows == h1.rows and h1.faults == []
+    g0 = GossipTrainer(_gossip_cfg()).run(rounds=2)
+    g1 = GossipTrainer(_gossip_cfg(FaultConfig())).run(rounds=2)
+    assert g0.rows == g1.rows and g1.faults == []
+
+
+def test_federated_faulted_deterministic_with_ledger(devices):
+    from dopt.engine import FederatedTrainer
+
+    fc = dataclasses.replace(_FAULTS, over_select=0.5, partition=0.3,
+                             partition_span=2)
+    ha = FederatedTrainer(_fed_cfg(fc)).run(rounds=3)
+    hb = FederatedTrainer(_fed_cfg(fc)).run(rounds=3)
+    assert ha.rows == hb.rows
+    assert ha.faults == hb.faults and ha.faults
+    for row in ha.faults:
+        assert set(row) == {"round", "worker", "kind", "action"}
+        assert row["kind"] in KINDS
+
+
+def test_federated_compact_full_width_parity_under_faults(devices):
+    # Sampled clients crash mid-round: the compact path (survivor lanes
+    # only) and the full-width path (mask-discard) must form the same
+    # masked average — identical ledgers, metrics equal to float
+    # summation order.
+    from dopt.engine import FederatedTrainer
+
+    # The compact path exists on single-device meshes only.
+    hc = FederatedTrainer(dataclasses.replace(
+        _fed_cfg(_FAULTS, compact=True), mesh_devices=1)).run(rounds=3)
+    hf = FederatedTrainer(dataclasses.replace(
+        _fed_cfg(_FAULTS, compact=False), mesh_devices=1)).run(rounds=3)
+    assert hc.faults == hf.faults and hc.faults
+    for rc, rf in zip(hc.rows, hf.rows):
+        assert set(rc) == set(rf)
+        for k in rc:
+            np.testing.assert_allclose(rc[k], rf[k], rtol=2e-4, atol=2e-5)
+
+
+def test_gossip_blocked_matches_per_round_under_faults(devices):
+    from dopt.engine import GossipTrainer
+
+    fc = dataclasses.replace(_FAULTS, partition=0.3, partition_span=2)
+    ha = GossipTrainer(_gossip_cfg(fc)).run(rounds=3, block=1)
+    hb = GossipTrainer(_gossip_cfg(fc)).run(rounds=3, block=3)
+    assert ha.rows == hb.rows
+    assert ha.faults == hb.faults and ha.faults
+
+
+def test_gossip_dropout_alias_back_compat(devices):
+    from dopt.engine import GossipTrainer
+
+    tr = GossipTrainer(_gossip_cfg(None, dropout=0.3))
+    assert tr.faults.active and tr.faults.cfg.crash == 0.3
+    h = tr.run(rounds=2)
+    assert all(r["kind"] == "crash" for r in h.faults)
+
+
+@pytest.mark.parametrize("engine", ["federated", "gossip"])
+def test_crash_exact_resume(engine, tmp_path, devices):
+    # Save at round 2 via checkpoint_every, restore into a FRESH
+    # trainer, run to round 4: History rows AND fault ledger must be
+    # bit-identical to an uninterrupted run (catches the round-offset
+    # RNG replay bug the engine comments warn about).
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    mk, cls = ((_fed_cfg, FederatedTrainer) if engine == "federated"
+               else (_gossip_cfg, GossipTrainer))
+    path = os.fspath(tmp_path / engine)
+    cont = cls(mk(_FAULTS))
+    hc = cont.run(rounds=4)
+    part = cls(mk(_FAULTS))
+    part.run(rounds=2, checkpoint_every=2, checkpoint_path=path)
+    res = cls(mk(_FAULTS))
+    res.restore(path)
+    assert res.round == 2
+    hr = res.run(rounds=2)
+    assert hr.rows == hc.rows
+    assert hr.faults == hc.faults
+
+
+def test_checkpoint_every_requires_path(devices):
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        FederatedTrainer(_fed_cfg()).run(rounds=1, checkpoint_every=1)
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        GossipTrainer(_gossip_cfg()).run(rounds=1, checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint hardening: truncation is detected, never loaded as garbage
+# ---------------------------------------------------------------------------
+
+def test_truncated_checkpoint_raises_clear_error(tmp_path):
+    from dopt.utils.checkpoint import (IncompleteCheckpointError,
+                                       load_checkpoint, save_checkpoint)
+
+    path = tmp_path / "ckpt"
+    arrays = {"theta": {"w": np.arange(64, dtype=np.float32)}}
+    save_checkpoint(path, arrays=arrays, meta={"round": 3})
+    a, m = load_checkpoint(path)          # intact: round-trips
+    assert m["round"] == 3
+    np.testing.assert_array_equal(a["theta"]["w"], arrays["theta"]["w"])
+
+    # Truncate the state payload mid-file (a mid-write crash / partial
+    # copy): the size manifest cross-check must reject it loudly.
+    state_files = [p for p in path.rglob("*")
+                   if p.is_file() and p.name not in ("meta.json",
+                                                     "complete.json")]
+    assert state_files
+    biggest = max(state_files, key=lambda p: p.stat().st_size)
+    biggest.write_bytes(biggest.read_bytes()[: biggest.stat().st_size // 2])
+    with pytest.raises(IncompleteCheckpointError, match="truncated"):
+        load_checkpoint(path)
+
+
+def test_half_written_checkpoint_falls_back_then_errors(tmp_path):
+    from dopt.utils.checkpoint import (IncompleteCheckpointError,
+                                       load_checkpoint, save_checkpoint)
+
+    path = tmp_path / "ckpt"
+    save_checkpoint(path, arrays={"x": np.ones(4)}, meta={"round": 1})
+    save_checkpoint(path, arrays={"x": np.full(4, 2.0)}, meta={"round": 2})
+    # Simulate a crash after the save deleted meta but before the swap:
+    # the primary is incomplete and there is no .old left.
+    (path / "meta.json").unlink()
+    with pytest.raises(IncompleteCheckpointError):
+        load_checkpoint(path)

@@ -151,6 +151,7 @@ def _seqlm_cfg(attn="ring", steps=24, **kw):
     return cfg.replace(seqlm=dataclasses.replace(cfg.seqlm, **fields))
 
 
+@pytest.mark.slow  # ~20s full seqlm run; covered faster by the ulysses twin
 def test_seqlm_trainer_loss_drops_on_mesh(devices):
     from dopt.engine import SeqLMTrainer
 
@@ -172,6 +173,7 @@ def test_seqlm_ulysses_runs_and_learns(devices):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~25s: two full seqlm runs (save + resume)
 def test_seqlm_checkpoint_resume(devices, tmp_path):
     import numpy as np
     import jax
@@ -205,6 +207,7 @@ def test_seqlm_validation(devices):
         SeqLMTrainer(_seqlm_cfg(attn="dense"))
 
 
+@pytest.mark.slow  # ~15s/param: chunked fwd+bwd vs dense, both causalities
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_kv_chunked_exact(devices, causal):
     """Within-block KV chunking (flash-style) must be EXACT vs both the

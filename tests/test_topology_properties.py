@@ -12,7 +12,12 @@ invariants every engine path relies on:
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed; the hypothesis-free "
+    "sweeps of the same invariants live in test_faults.py")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from dopt.topology import (build_mixing_matrices, repair_for_dropout,
                            shift_decomposition)
