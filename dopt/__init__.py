@@ -24,10 +24,12 @@ Reference layer map: see SURVEY.md §1 in the repository root.
 from dopt.config import (
     DataConfig,
     ExperimentConfig,
+    FaultConfig,
     FederatedConfig,
     GossipConfig,
     ModelConfig,
     OptimizerConfig,
+    RobustConfig,
     SeqLMConfig,
     from_reference_args,
 )
@@ -63,6 +65,8 @@ __all__ = [
     "from_reference_args",
     "DataConfig",
     "ExperimentConfig",
+    "FaultConfig",
+    "RobustConfig",
     "FederatedConfig",
     "GossipConfig",
     "ModelConfig",

@@ -225,11 +225,13 @@ class GossipConfig:
     # (comm_dtype=None) are bit-identical across both paths and any
     # fold — that equality is what the test suite pins.
     dropout: float = 0.0
-    # Fault injection: per-round probability each worker is down.  Down
-    # workers skip consensus AND local training for the round; the mixing
-    # matrix is repaired (edges removed, rows renormalised —
-    # dopt.topology.repair_for_dropout) and they rejoin with stale
-    # params.  The reference has no failure handling at all (SURVEY §5).
+    # DEPRECATED back-compat alias for FaultConfig(crash=p) — warns at
+    # trainer construction and produces the identical fault trace
+    # (dopt.faults.FaultPlan synthesizes the config); set
+    # ExperimentConfig.faults instead.  Per-round probability each
+    # worker is down: down workers skip consensus AND local training,
+    # the mixing matrix is repaired (dopt.topology.repair_for_dropout)
+    # and they rejoin with stale params.
 
 
 @dataclass(frozen=True)
@@ -274,7 +276,71 @@ class FaultConfig:
     # server; other groups are unreachable for the span.
     partition_span: int = 2     # rounds a partition lasts once started
     partition_groups: int = 2   # number of sides of the cut
+    corrupt: float = 0.0
+    # Per-round per-worker probability the worker LIES: its contributed
+    # update (federated) / the state it broadcasts to neighbors (gossip)
+    # is replaced by a corrupted value before aggregation — the
+    # Byzantine threat model, vs. crash's fail-stop model.  Crashes win
+    # ties (a down worker sends nothing).  Injection happens INSIDE the
+    # jitted round functions (``dopt.faults.corrupt_update``) from the
+    # same stateless per-round streams, so corrupted runs stay
+    # bit-reproducible, blocked-execution-exact and resume-exact.
+    corrupt_mode: str = "nan"
+    # What the lie looks like: 'nan' | 'inf' (non-finite poison),
+    # 'scale' (norm blow-up by corrupt_scale), 'signflip' (update
+    # negated through the reference point), 'stale' (replay of the
+    # worker's previous update; federated engine only — gossip carries
+    # no per-worker previous-send state).
+    corrupt_scale: float = 100.0   # blow-up factor for mode='scale'
+    corrupt_max: int = 0
+    # Cap on corrupted workers per round (0 = no cap).  The cap keeps
+    # the LOWEST-INDEXED workers among the round's draws, so
+    # ``corrupt=1.0, corrupt_max=f`` pins workers 0..f-1 as PERSISTENT
+    # adversaries — the classic fixed-f Byzantine setting robust
+    # aggregators state their breakdown points against.
     seed: int | None = None     # fault-stream seed; None = experiment seed
+
+
+@dataclass(frozen=True)
+class RobustConfig:
+    """Byzantine-robust aggregation & quarantine (``dopt.robust``).
+
+    The defense side of the threat model: ``FaultConfig.corrupt``
+    injects lies, this config decides what the aggregation layer does
+    about them.  ``None`` (or all defaults) keeps the exact masked-mean
+    programs — clean runs stay bit-identical."""
+
+    aggregator: str = "mean"
+    # Federated server aggregation over the round's surviving updates:
+    # 'mean' (the reference masked average, breakdown point 0),
+    # 'trimmed_mean' (coordinate-wise, tolerates < trim_frac·n liars),
+    # 'median' (coordinate-wise, breakdown 1/2), 'krum' / 'multi_krum'
+    # (distance-based selection, tolerates f with n > 2f + 2).
+    # All are jittable pure functions of (stacked updates, mask).
+    trim_frac: float = 0.1
+    # trimmed_mean: fraction trimmed from EACH end per coordinate
+    # (k = floor(trim_frac · n_alive), clamped so >= 1 value survives).
+    krum_f: int = 1
+    # krum/multi_krum: assumed number of Byzantine workers f; each
+    # worker is scored by its n_alive − f − 2 closest neighbors.
+    multi_krum_m: int = 0
+    # multi_krum: average the m best-scored workers (0 = auto:
+    # n_alive − krum_f).  krum is multi_krum with m = 1.
+    clip_radius: float = 0.0
+    # Norm clip (0 = off).  Federated: worker updates are clipped to an
+    # L2 ball of this radius around theta before aggregation.  Gossip:
+    # the clipped-gossip rule — each worker clips every neighbor
+    # DEVIATION ``x_j − x_i`` to this radius before applying the mixing
+    # weights, so one liar moves any honest worker at most
+    # W_ij·clip_radius per round (composes with partition/crash repair,
+    # which act on the matrix itself).
+    quarantine_after: int = 0
+    # Detection/quarantine layer (0 = off): a worker whose update is
+    # screened (non-finite, or majority-clipped in gossip) this many
+    # rounds IN A ROW is quarantined — masked out via the engines'
+    # existing alive/participation machinery and recorded in the fault
+    # ledger — then readmitted after ``quarantine_rounds``.
+    quarantine_rounds: int = 8  # backoff length before readmission
 
 
 @dataclass(frozen=True)
@@ -318,8 +384,13 @@ class ExperimentConfig:
     seqlm: SeqLMConfig | None = None
     faults: FaultConfig | None = None
     # Fault injection & recovery (dopt.faults.FaultPlan): crashes,
-    # stragglers, partitions for the federated/gossip engines.  None =
-    # fault-free (bit-identical to a config without the field).
+    # stragglers, partitions, Byzantine corruption for the
+    # federated/gossip engines.  None = fault-free (bit-identical to a
+    # config without the field).
+    robust: RobustConfig | None = None
+    # Byzantine-robust aggregation & quarantine (dopt.robust).  None =
+    # the plain masked-mean programs (bit-identical to pre-robust runs;
+    # non-finite updates are still screened from the federated mean).
     # Execution backend — the pluggable Worker(backend=...) boundary:
     # "jax" runs the TPU/mesh engines; "torch" runs the SAME experiment
     # on the faithful sequential CPU oracle (dopt.engine.torch_backend)
@@ -443,7 +514,8 @@ def from_reference_args(args: Mapping[str, Any]) -> ExperimentConfig:
 def exp_details(cfg: ExperimentConfig) -> str:
     """Human-readable config dump (reference ``exp_details``, utils.py:147-165)."""
     lines = [f"Experiment: {cfg.name}", f"  seed      : {cfg.seed}", f"  backend   : {cfg.backend}"]
-    for section in ("data", "model", "optim", "federated", "gossip", "faults"):
+    for section in ("data", "model", "optim", "federated", "gossip", "faults",
+                    "robust"):
         sub = getattr(cfg, section)
         if sub is None:
             continue

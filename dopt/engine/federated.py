@@ -45,11 +45,13 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                prepare_holdout, validate_optimizer)
-from dopt.faults import FaultPlan
+from dopt.faults import FaultPlan, corrupt_update
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
                                         where_mask as _where_mask)
+from dopt.robust import (clip_to_ball, finite_lane_mask, make_aggregator,
+                         masked_mean, validate_robust_config)
 from dopt.parallel.mesh import make_worker_mesh, shard_worker_tree, worker_sharding
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
@@ -108,6 +110,42 @@ class FederatedTrainer:
         may_straggle = (self.faults.may_straggle
                         and cfg.faults.straggler_policy == "partial")
         self._may_straggle = may_straggle
+
+        # Byzantine threat model (dopt.robust): corrupt-update injection
+        # rides the same stateless per-round fault streams; the defense
+        # is the aggregation layer.  The non-finite screen is ALWAYS on
+        # (a NaN/Inf update is treated as failed for the round instead
+        # of silently poisoning theta); robust aggregators / clipping /
+        # quarantine activate only when configured, and with
+        # aggregator='mean' the exact pre-robust masked-average call is
+        # kept so clean runs stay bit-identical.
+        has_corrupt = self.faults.has_corrupt
+        self._has_corrupt = has_corrupt
+        corrupt_mode = cfg.faults.corrupt_mode if has_corrupt else "nan"
+        corrupt_scale = cfg.faults.corrupt_scale if has_corrupt else 1.0
+        rcfg = cfg.robust
+        if rcfg is not None:
+            validate_robust_config(rcfg)
+        aggregator = rcfg.aggregator if rcfg is not None else "mean"
+        clip_radius = rcfg.clip_radius if rcfg is not None else 0.0
+        if aggregator != "mean" and f.comm_dtype:
+            raise ValueError(
+                "comm_dtype wire compression only applies to the masked-"
+                f"mean reduce; aggregator={aggregator!r} is a full-"
+                "precision robust statistic — drop one of the two")
+        agg_robust = (make_aggregator(aggregator, trim_frac=rcfg.trim_frac,
+                                      krum_f=rcfg.krum_f,
+                                      multi_krum_m=rcfg.multi_krum_m)
+                      if aggregator != "mean" else None)
+        # Detection/quarantine layer: host-side state, fed by per-round
+        # screened flags from the device step; checkpointed so resumed
+        # runs replay it exactly.
+        self._quarantine_on = bool(rcfg is not None
+                                   and rcfg.quarantine_after > 0)
+        self._quarantine_after = rcfg.quarantine_after if rcfg else 0
+        self._quarantine_rounds = rcfg.quarantine_rounds if rcfg else 0
+        self._screen_streak = np.zeros(w, np.int64)
+        self._quarantine_until = np.zeros(w, np.int64)
 
         self.dataset = load_dataset(
             cfg.data.dataset, data_dir=cfg.data.data_dir,
@@ -376,27 +414,29 @@ class FederatedTrainer:
                 c_global, sub_new, sub_old,
             )
 
-        def pack_host_metrics(local_loss, evalm, trainm, em):
+        def pack_host_metrics(local_loss, evalm, trainm, em, screened):
             """Everything the host reads per round, as ONE flat f32
             vector — every device→host fetch pays a fixed ~100 ms tunnel
             round-trip on this hardware, so the round's history metrics
-            (local loss, global eval, worker-mean train eval, and the
-            per-epoch client-history block under the holdout) travel in
-            a single transfer.  Layout (mirrored by
-            ``_unpack_host_metrics``): [local_loss, test_acc,
-            test_loss_sum, mean(train_loss), mean(train_acc)] +
-            4×[lanes·E] em blocks."""
+            (local loss, global eval, worker-mean train eval, the
+            non-finite-screen flags, and the per-epoch client-history
+            block under the holdout) travel in a single transfer.
+            Layout (mirrored by ``_unpack_host_metrics``): [local_loss,
+            test_acc, test_loss_sum, mean(train_loss), mean(train_acc)]
+            + [lanes] screened flags + 4×[lanes·E] em blocks."""
             parts = [local_loss.reshape(1),
                      evalm["acc"][None], evalm["loss_sum"][None],
                      jnp.mean(trainm["loss_mean"])[None],
-                     jnp.mean(trainm["acc"])[None]]
+                     jnp.mean(trainm["acc"])[None],
+                     screened.ravel()]
             if use_holdout:
                 parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
                           em["val_acc"].ravel(), em["val_loss_sum"].ravel()]
             return jnp.concatenate([p.astype(jnp.float32) for p in parts])
 
         def finish(new_theta, new_p, new_m, new_duals, new_c, local_loss,
-                   em, train_x, train_y, ex, ey, ew, tidx, tweight):
+                   em, screened, train_x, train_y, ex, ey, ew, tidx,
+                   tweight):
             """Shared round tail: global test eval + all-client train eval
             (``avg_trainig_calculator``) — identical for both execution
             paths so the history schema can never diverge between them.
@@ -411,45 +451,78 @@ class FederatedTrainer:
                           "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
             return (new_theta, new_p, new_m, new_duals, new_c,
                     pack_host_metrics(jnp.asarray(local_loss), evalm,
-                                      trainm, em))
+                                      trainm, em, screened))
 
         def round_fn(theta, params, mom, duals, c_global, mask, limits, idx,
                      bweight, train_x, train_y, ex, ey, ew, tidx, tweight,
-                     vidx, vw):
+                     vidx, vw, cmask=None):
             theta_b = broadcast_to_workers(theta, w)
             start = _where_mask(mask, theta_b, params)
             p_t, m_t, losses, accs, sub_new, em = algo_step(
                 theta, start, mom, duals, c_global, idx, bweight, limits,
                 train_x, train_y, vidx, vw)
+            if has_corrupt:
+                # Byzantine injection INSIDE the jitted round (the lanes
+                # flagged by the plan's stateless per-round draw lie
+                # about their update), so corrupted runs stay
+                # bit-reproducible and block/compact/resume-exact.
+                p_t = corrupt_update(p_t, cmask, corrupt_mode,
+                                     corrupt_scale, ref=theta, prev=params)
+                if algorithm in ("scaffold", "fedadmm"):
+                    # A liar lies on EVERY channel it reports: its
+                    # companion-state update (SCAFFOLD control / ADMM
+                    # dual) is corrupted under the same mask.  Note the
+                    # robust aggregators defend theta only — the
+                    # companion channel reaches c_global/duals
+                    # unaggregated, a real SCAFFOLD-under-Byzantine
+                    # exposure (see docs/ARCHITECTURE.md Threat model).
+                    sub_new = corrupt_update(sub_new, cmask, corrupt_mode,
+                                             corrupt_scale, prev=duals)
+            # Non-finite screen — always on, the guard on the default
+            # mean path: a lane whose update carries NaN/Inf is treated
+            # as failed for the round, excluded from the aggregate AND
+            # from the carried state so the poison never propagates.
+            fin = finite_lane_mask(p_t)
+            agg_mask = mask * fin
             if algorithm in ("scaffold", "fedadmm"):
-                new_duals = _where_mask(mask, sub_new, duals)
+                new_duals = _where_mask(agg_mask, sub_new, duals)
             else:
                 new_duals = duals
             new_c = (control_delta(c_global, new_duals, duals)
                      if algorithm == "scaffold" else c_global)
-            new_p = _where_mask(mask, p_t, params)
+            new_p = _where_mask(agg_mask, p_t, params)
             # Scaffold momentum is per-round-local (fresh buffer each
             # round), so the carried buffer stays untouched zeros and is
             # not checkpointed; the other algorithms persist it like the
             # reference's lifetime client optimizers.
-            new_m = mom if algorithm == "scaffold" else _where_mask(mask, m_t, mom)
-            new_theta = masked_average(new_p, mask, mesh=agg_mesh,
-                                       comm_dtype=agg_comm)
-            if has_faults:
-                # A round whose every sampled client failed leaves the
-                # global model unchanged (the masked average over zero
-                # survivors would otherwise zero theta).
-                alive_any = mask.sum() > 0
-                new_theta = jax.tree.map(
-                    lambda a, th: jnp.where(alive_any, a, th),
-                    new_theta, theta)
-            local_loss = (losses.mean(axis=1) * mask).sum() / jnp.maximum(mask.sum(), 1)
+            new_m = (mom if algorithm == "scaffold"
+                     else _where_mask(agg_mask, m_t, mom))
+            agg_in = (clip_to_ball(new_p, theta, clip_radius)
+                      if clip_radius > 0 else new_p)
+            if agg_robust is None:
+                new_theta = masked_average(agg_in, agg_mask, mesh=agg_mesh,
+                                           comm_dtype=agg_comm)
+            else:
+                new_theta = agg_robust(agg_in, agg_mask)
+            # A round with zero surviving (unscreened) updates leaves
+            # the global model unchanged (the aggregate over zero
+            # survivors would otherwise zero theta).
+            alive_any = agg_mask.sum() > 0
+            new_theta = jax.tree.map(
+                lambda a, th: jnp.where(alive_any, a, th), new_theta, theta)
+            lane_loss = losses.mean(axis=1)
+            lane_loss = jnp.where(jnp.isfinite(lane_loss), lane_loss, 0.0)
+            local_loss = ((lane_loss * agg_mask).sum()
+                          / jnp.maximum(agg_mask.sum(), 1))
+            # Sampled-and-screened flags travel to the host for the
+            # ledger and the quarantine streaks.
+            screened = mask * (1.0 - fin)
             # Full-width packs ALL W lanes' em rows (gathering the
             # sampled subset would be a dynamic shape); the host slices
             # by the round's sample before appending client rows.
             return finish(new_theta, new_p, new_m, new_duals, new_c,
-                          local_loss, em, train_x, train_y, ex, ey, ew, tidx,
-                          tweight)
+                          local_loss, em, screened, train_x, train_y, ex,
+                          ey, ew, tidx, tweight)
 
         # Per-worker train-split eval: every input has a worker axis.
         # Batches come from the FLAT resident train arrays (finish()
@@ -483,7 +556,8 @@ class FederatedTrainer:
 
         def compact_round_fn(theta, params, mom, duals, c_global, sel,
                              limits_sel, idx_sel, bw_sel, train_x, train_y,
-                             ex, ey, ew, tidx, tweight, vidx, vw):
+                             ex, ey, ew, tidx, tweight, vidx, vw,
+                             cmask=None):
             """Compact-sampling fast path: only the m = len(sel) sampled
             workers' lanes are trained ([m, ...] gather → local update →
             scatter-back), instead of all N lanes computing and the mask
@@ -501,22 +575,57 @@ class FederatedTrainer:
             m = sel.shape[0]
             start = broadcast_to_workers(theta, m)
             duals_sel = _take(duals, sel)
+            prev_sel = _take(params, sel)
             p_t, m_t, losses, accs, sub_new, em = algo_step(
                 theta, start, _take(mom, sel), duals_sel, c_global,
                 idx_sel, bw_sel, limits_sel, train_x, train_y,
                 vidx[sel], vw[sel])
+            if has_corrupt:
+                p_t = corrupt_update(p_t, cmask, corrupt_mode,
+                                     corrupt_scale, ref=theta, prev=prev_sel)
+                if algorithm in ("scaffold", "fedadmm"):
+                    # Same companion-channel lie as the full-width path.
+                    sub_new = corrupt_update(sub_new, cmask, corrupt_mode,
+                                             corrupt_scale, prev=duals_sel)
+            # Non-finite screen over the m survivor lanes — a screened
+            # lane keeps its stale state and leaves the aggregate, same
+            # semantics as the full-width path.  ``all_fin`` selects the
+            # exact pre-robust expressions when nothing was screened, so
+            # clean compact runs stay bit-identical.
+            fin = finite_lane_mask(p_t)
+            all_fin = fin.min() >= 1.0
+            sub_new_g = _where_mask(fin, sub_new, duals_sel)
             if algorithm in ("scaffold", "fedadmm"):
-                new_duals = _scatter(duals, sel, sub_new)
+                new_duals = _scatter(duals, sel, sub_new_g)
             else:
                 new_duals = duals
-            new_c = (control_delta(c_global, sub_new, duals_sel)
+            new_c = (control_delta(c_global, sub_new_g, duals_sel)
                      if algorithm == "scaffold" else c_global)
-            new_p = _scatter(params, sel, p_t)
-            new_m = mom if algorithm == "scaffold" else _scatter(mom, sel, m_t)
-            new_theta = jax.tree.map(lambda x: x.mean(axis=0), p_t)
+            p_keep = _where_mask(fin, p_t, prev_sel)
+            new_p = _scatter(params, sel, p_keep)
+            new_m = (mom if algorithm == "scaffold"
+                     else _scatter(mom, sel,
+                                   _where_mask(fin, m_t, _take(mom, sel))))
+            agg_in = (clip_to_ball(p_keep, theta, clip_radius)
+                      if clip_radius > 0 else p_keep)
+            if agg_robust is None:
+                plain = jax.tree.map(lambda x: x.mean(axis=0), agg_in)
+                masked = masked_mean(agg_in, fin)
+                new_theta = jax.tree.map(
+                    lambda a, b: jnp.where(all_fin, a, b), plain, masked)
+            else:
+                new_theta = agg_robust(agg_in, fin)
+            any_fin = fin.sum() > 0
+            new_theta = jax.tree.map(
+                lambda a, th: jnp.where(any_fin, a, th), new_theta, theta)
+            lane_loss = losses.mean(axis=1)
+            lane_loss = jnp.where(jnp.isfinite(lane_loss), lane_loss, 0.0)
+            local_loss = jnp.where(
+                all_fin, losses.mean(),
+                (lane_loss * fin).sum() / jnp.maximum(fin.sum(), 1))
             return finish(new_theta, new_p, new_m, new_duals, new_c,
-                          losses.mean(), em, train_x, train_y, ex, ey, ew,
-                          tidx, tweight)
+                          local_loss, em, 1.0 - fin, train_x, train_y, ex,
+                          ey, ew, tidx, tweight)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
@@ -526,7 +635,29 @@ class FederatedTrainer:
             per distinct k).  Each iteration is one full reference round
             — sampled-client theta load, local epochs, masked average,
             global + per-client train eval — so history rows are
-            identical to the per-round path's."""
+            identical to the per-round path's.  Under corrupt faults the
+            per-round corrupt masks ride the scan as one more stacked
+            input; the clean signature is unchanged."""
+
+            if has_corrupt:
+                def block_fn(theta, params, mom, duals, c_global, gates,
+                             limits, cmasks, idxs, bws, train_x, train_y,
+                             ex, ey, ew, tidx, tweight, vidx, vw):
+                    def body(carry, xs):
+                        th, p, m, d, c = carry
+                        gate, lim, cm, idx, bw = xs
+                        th, p, m, d, c, packed = one_round(
+                            th, p, m, d, c, gate, lim, idx, bw,
+                            train_x, train_y, ex, ey, ew, tidx, tweight,
+                            vidx, vw, cmask=cm)
+                        return (th, p, m, d, c), packed
+
+                    carry, packed = jax.lax.scan(
+                        body, (theta, params, mom, duals, c_global),
+                        (gates, limits, cmasks, idxs, bws))
+                    return (*carry, packed)
+
+                return jax.jit(block_fn, donate_argnums=(1, 2, 3))
 
             def block_fn(theta, params, mom, duals, c_global, gates, limits,
                          idxs, bws, train_x, train_y, ex, ey, ew, tidx,
@@ -567,20 +698,33 @@ class FederatedTrainer:
         return mask
 
     def _round_participation(
-            self, t: int, frac: float) -> tuple[np.ndarray, np.ndarray]:
+            self, t: int, frac: float
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list]:
         """Sample round t's clients and apply its faults: returns
-        (survivor indices, [W] straggler work limits).
+        (survivor indices, [W] straggler work limits, [W] corrupt mask,
+        the round's host-side fault-ledger rows).
 
         Fault-free this is exactly ``_sample_indices`` (same RNG call,
         same stream — enabling the fault machinery never perturbs the
         sampling sequence).  With faults on, the FedAvg-paper server
         deadline runs on the host: over-select ceil(m·(1+over_select))
-        clients, drop the crashed / partition-unreachable /
-        deadline-dropped ones, keep the first m survivors and release
-        the surplus.  Every action lands in the fault ledger
-        (``history.faults``) — draws are stateless per round
-        (dopt.faults.FaultPlan), so per-round, blocked, and
-        killed-and-resumed execution log the identical trace."""
+        clients, drop the quarantined / crashed / partition-unreachable
+        / deadline-dropped ones, keep the first m survivors and release
+        the surplus.  Ledger rows are RETURNED rather than appended so
+        both execution paths (per-round and fused-block) can interleave
+        them with the device-side screened rows in the identical order —
+        draws are stateless per round (dopt.faults.FaultPlan), so
+        per-round, blocked, and killed-and-resumed execution log the
+        identical trace."""
+        rows: list[dict] = []
+        if self._quarantine_on:
+            expired = ((self._quarantine_until != 0)
+                       & (t >= self._quarantine_until))
+            for i in np.nonzero(expired)[0]:
+                rows.append({"round": int(t), "worker": int(i),
+                             "kind": "quarantine", "action": "readmitted"})
+                self._quarantine_until[i] = 0
+                self._screen_streak[i] = 0
         m = max(int(frac * self.num_workers), 1)
         c = self.faults.cfg
         n_draw = m
@@ -596,37 +740,79 @@ class FederatedTrainer:
             self.num_workers, n_draw, replace=False).astype(np.int32)
         rf = self.faults.for_round(t)
         limits = FaultPlan.limits_for(rf, self._straggle_units)
-        if not rf.any_fault and n_draw == m:
-            return np.sort(chosen), limits
+        cmask = np.zeros(self.num_workers, np.float32)
+        quarantined_now = (self._quarantine_on
+                           and bool((self._quarantine_until > t).any()))
+        if not rf.any_fault and n_draw == m and not quarantined_now:
+            return np.sort(chosen), limits, cmask, rows
         drop_policy = c is not None and c.straggler_policy == "drop"
         survivors: list[int] = []
         for i in chosen:
             i = int(i)
-            if rf.crashed[i]:
-                self.history.log_fault(round=t, worker=i, kind="crash",
-                                       action="dropped_from_round")
+            if quarantined_now and t < self._quarantine_until[i]:
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "quarantine",
+                             "action": "excluded_while_quarantined"})
+            elif rf.crashed[i]:
+                rows.append({"round": int(t), "worker": i, "kind": "crash",
+                             "action": "dropped_from_round"})
             elif rf.partition is not None and rf.partition[i] != 0:
                 # Only group 0 can reach the server for the span.
-                self.history.log_fault(
-                    round=t, worker=i, kind="partition",
-                    action=f"unreachable_in_group_{int(rf.partition[i])}")
+                rows.append({
+                    "round": int(t), "worker": i, "kind": "partition",
+                    "action": f"unreachable_in_group_{int(rf.partition[i])}"})
             elif rf.straggler[i] and drop_policy:
-                self.history.log_fault(round=t, worker=i, kind="straggler",
-                                       action="deadline_dropped")
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "straggler",
+                             "action": "deadline_dropped"})
             else:
                 survivors.append(i)
         for i in survivors[m:]:
-            self.history.log_fault(round=t, worker=i, kind="overselect",
-                                   action="released_surplus")
+            rows.append({"round": int(t), "worker": i, "kind": "overselect",
+                         "action": "released_surplus"})
         survivors = np.sort(np.asarray(survivors[:m], np.int32))
         if self._may_straggle:
             for i in survivors:
                 if rf.straggler[i]:
-                    self.history.log_fault(
-                        round=t, worker=int(i), kind="straggler",
-                        action=(f"truncated_to_{int(limits[i])}"
-                                f"_of_{self._straggle_units}"))
-        return survivors, limits
+                    rows.append({
+                        "round": int(t), "worker": int(i),
+                        "kind": "straggler",
+                        "action": (f"truncated_to_{int(limits[i])}"
+                                   f"_of_{self._straggle_units}")})
+        if self._has_corrupt and rf.corrupt is not None:
+            mode = self.cfg.faults.corrupt_mode
+            for i in survivors:
+                if rf.corrupt[i]:
+                    cmask[i] = 1.0
+                    rows.append({"round": int(t), "worker": int(i),
+                                 "kind": "corrupt",
+                                 "action": f"injected_{mode}"})
+        return survivors, limits, cmask, rows
+
+    def _apply_screen_feedback(self, t: int, workers, flags,
+                               rows: list) -> None:
+        """Fold the device step's non-finite-screen flags (aligned with
+        ``workers``, the round's surviving sampled clients) into the
+        ledger and the quarantine streaks: K consecutive screened
+        participations quarantine the worker for ``quarantine_rounds``;
+        one clean participation resets the streak."""
+        for j, wid in enumerate(np.asarray(workers).reshape(-1)):
+            wid = int(wid)
+            if float(flags[j]) > 0.5:
+                self._screen_streak[wid] += 1
+                rows.append({"round": int(t), "worker": wid,
+                             "kind": "corrupt",
+                             "action": "screened_nonfinite"})
+                if (self._quarantine_on and self._screen_streak[wid]
+                        >= self._quarantine_after):
+                    until = int(t) + 1 + self._quarantine_rounds
+                    self._quarantine_until[wid] = until
+                    self._screen_streak[wid] = 0
+                    rows.append({"round": int(t), "worker": wid,
+                                 "kind": "quarantine",
+                                 "action": f"quarantined_until_{until}"})
+            else:
+                self._screen_streak[wid] = 0
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
@@ -686,6 +872,7 @@ class FederatedTrainer:
             with self.timers.phase("host_batch_plan"):
                 parts = [self._round_participation(t, frac) for t in ts]
                 sels = [p[0] for p in parts]
+                frows = [p[3] for p in parts]
                 plans = [
                     make_batch_plan(
                         self._train_matrix, batch_size=f.local_bs,
@@ -695,10 +882,17 @@ class FederatedTrainer:
                     )
                     for t, sel in zip(ts, sels)
                 ]
+                if self._has_corrupt:
+                    # Only the full-width path reaches here with faults
+                    # active (run() forces per-round for compact+faults,
+                    # where survivor counts vary), so the [k, W] corrupt
+                    # masks stack directly.
+                    assert not compact
+                    cms = jnp.asarray(np.stack([p[2] for p in parts]))
                 if compact:
                     gates = jnp.asarray(np.stack(sels))
                     limits = jnp.asarray(
-                        np.stack([lim[sel] for sel, lim in parts]))
+                        np.stack([p[1][sel] for sel, p in zip(sels, parts)]))
                     idx = jnp.asarray(np.stack([p.idx for p in plans]))
                     bw = jnp.asarray(np.stack([p.weight for p in plans]))
                 else:
@@ -714,11 +908,14 @@ class FederatedTrainer:
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
             fn = self._compact_block_fn if compact else self._block_fn
+            args = [gates, limits]
+            if self._has_corrupt:
+                args.append(cms)
             (self.theta, self.params, self.momentum, new_duals, new_c,
              packed) = self.timers.measure(
                 "round_step", fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
-                gates, limits, idx, bw, self._train_x, self._train_y,
+                *args, idx, bw, self._train_x, self._train_y,
                 *self._eval,
                 self._train_eval_idx, self._train_eval_w, *self._val,
             )
@@ -729,8 +926,11 @@ class FederatedTrainer:
             packed = np.asarray(packed)  # ONE device→host fetch per block
             lanes = len(sels[0]) if compact else self.num_workers
             for j, t in enumerate(ts):
-                ll, acc, loss_sum, t_loss, t_acc, em = \
+                ll, acc, loss_sum, t_loss, t_acc, scr, em = \
                     self._unpack_host_metrics(packed[j], lanes)
+                flags = scr if compact else scr[sels[j]]
+                self._apply_screen_feedback(t, sels[j], flags, frows[j])
+                self.history.faults.extend(frows[j])
                 self.history.append(
                     round=t,
                     test_acc=acc,
@@ -771,11 +971,15 @@ class FederatedTrainer:
         block = f.block_rounds if block is None else block
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
-        if block > 1 and not (self.faults.active
-                              and self._use_compact(frac)):
+        if (block > 1
+                and not (self.faults.active and self._use_compact(frac))
+                and not self._quarantine_on):
             # Compact + faults stays per-round: survivor counts vary
             # round to round and the compact block stacks fixed-width
-            # lane sets.
+            # lane sets.  Quarantine stays per-round too: the next
+            # round's participation depends on THIS round's device-side
+            # screen flags, which a fused block only surfaces at its
+            # end.
             return self._run_blocked(frac, rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
@@ -784,7 +988,7 @@ class FederatedTrainer:
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                sel, limits = self._round_participation(t, frac)
+                sel, limits, cmask, frows = self._round_participation(t, frac)
                 # The compact path needs >= 1 survivor lane; a round
                 # whose every sampled client failed degrades to one
                 # full-width step with an all-zero mask (theta and all
@@ -812,6 +1016,8 @@ class FederatedTrainer:
             c_in = self.c_global if self.c_global is not None else {}
             step_fn = self._compact_fn if use_c else self._round_fn
             gate = jnp.asarray(sel) if use_c else jnp.asarray(mask)
+            step_kw = ({"cmask": jnp.asarray(cmask[sel] if use_c else cmask)}
+                       if self._has_corrupt else {})
             (self.theta, self.params, self.momentum, new_duals, new_c,
              packed) = self.timers.measure(
                 "round_step", step_fn,
@@ -819,14 +1025,19 @@ class FederatedTrainer:
                 gate, lim_dev, idx, bweight,
                 self._train_x, self._train_y, *self._eval,
                 self._train_eval_idx, self._train_eval_w, *self._val,
+                **step_kw,
             )
             if self.duals is not None:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
             lanes = len(sel) if use_c else self.num_workers
-            ll, acc, loss_sum, t_loss, t_acc, em = self._unpack_host_metrics(
-                np.asarray(packed), lanes)  # ONE device→host fetch per round
+            ll, acc, loss_sum, t_loss, t_acc, scr, em = \
+                self._unpack_host_metrics(
+                    np.asarray(packed), lanes)  # ONE device→host fetch/round
+            flags = scr if use_c else scr[sel]
+            self._apply_screen_feedback(t, sel, flags, frows)
+            self.history.faults.extend(frows)
             self.history.append(
                 round=t,
                 test_acc=acc,
@@ -848,17 +1059,19 @@ class FederatedTrainer:
     def _unpack_host_metrics(self, vec: np.ndarray, lanes: int):
         """Inverse of the round step's ``pack_host_metrics``: one fetched
         f32 vector → (local_loss, test_acc, test_loss_sum, train_loss,
-        train_acc, em dict of [lanes, E] arrays or {})."""
+        train_acc, [lanes] screened flags, em dict of [lanes, E] arrays
+        or {})."""
         ll, acc, loss_sum, t_loss, t_acc = (float(v) for v in vec[:5])
+        scr = vec[5:5 + lanes]
         em: dict[str, np.ndarray] = {}
         if self._holdout:
             e = self.cfg.federated.local_ep
             n = lanes * e
-            body = vec[5:]
+            body = vec[5 + lanes:]
             for i, k in enumerate(("train_loss", "train_acc", "val_acc",
                                    "val_loss")):
                 em[k] = body[i * n:(i + 1) * n].reshape(lanes, e)
-        return ll, acc, loss_sum, t_loss, t_acc, em
+        return ll, acc, loss_sum, t_loss, t_acc, scr, em
 
     def _append_client_rows(self, t: int, em: dict, workers) -> None:
         """Per-epoch per-client history rows (P1 Client.history schema,
@@ -898,6 +1111,8 @@ class FederatedTrainer:
                   "history": self.history.rows,
                   "client_history": self.client_history.rows,
                   "fault_ledger": self.history.faults,
+                  "screen_streak": self._screen_streak.tolist(),
+                  "quarantine_until": self._quarantine_until.tolist(),
                   "sample_rng_state": self._sample_rng.bit_generator.state},
         )
 
@@ -932,6 +1147,11 @@ class FederatedTrainer:
         self.history.rows = list(meta.get("history", []))
         self.history.faults = list(meta.get("fault_ledger", []))
         self.client_history.rows = list(meta.get("client_history", []))
+        w = self.num_workers
+        self._screen_streak = np.asarray(
+            meta.get("screen_streak", [0] * w), np.int64)
+        self._quarantine_until = np.asarray(
+            meta.get("quarantine_until", [0] * w), np.int64)
         if meta.get("sample_rng_state"):
             self._sample_rng.bit_generator.state = meta["sample_rng_state"]
 

@@ -45,7 +45,9 @@ from dopt.parallel.collectives import mix_dense, mix_shifts, where_mask
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
                                 worker_sharding)
-from dopt.faults import FaultPlan
+from dopt.faults import FaultPlan, corrupt_update
+from dopt.robust import (byzantine_mix, clipped_gossip_mix,
+                         finite_lane_mask, validate_robust_config)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
                            coeffs_for_matrix, repair_for_dropout,
                            repair_for_partition,
@@ -245,6 +247,68 @@ class GossipTrainer:
         has_faults = self.faults.active
         may_straggle = self.faults.may_straggle
 
+        # Byzantine threat model (dopt.robust): workers can LIE on the
+        # wire — their broadcast state is corrupted inside the jitted
+        # round — and the defense is clipped gossip (every neighbor
+        # deviation norm-clipped before the mixing weights apply) plus
+        # the detection/quarantine layer.  All of it is gated on
+        # ``robust_active`` so clean runs compile the exact pre-robust
+        # program.
+        has_corrupt = self.faults.has_corrupt
+        self._has_corrupt = has_corrupt
+        corrupt_mode = cfg.faults.corrupt_mode if has_corrupt else "nan"
+        corrupt_scale = cfg.faults.corrupt_scale if has_corrupt else 1.0
+        rcfg = cfg.robust
+        if rcfg is not None:
+            validate_robust_config(rcfg)
+            if rcfg.aggregator != "mean":
+                raise ValueError(
+                    "server-side robust aggregators are a federated-engine "
+                    "knob; the gossip defense is clipped mixing "
+                    "(RobustConfig.clip_radius)")
+        clip_tau = rcfg.clip_radius if rcfg is not None else 0.0
+        self._quarantine_on = bool(rcfg is not None
+                                   and rcfg.quarantine_after > 0)
+        self._quarantine_after = rcfg.quarantine_after if rcfg else 0
+        self._quarantine_rounds = rcfg.quarantine_rounds if rcfg else 0
+        self._screen_streak = np.zeros(w, np.int64)
+        self._quarantine_until = np.zeros(w, np.int64)
+        robust_active = has_corrupt or clip_tau > 0 or self._quarantine_on
+        self._robust_active = robust_active
+        if has_corrupt:
+            if cfg.faults.corrupt_mode == "stale":
+                raise ValueError(
+                    "corrupt_mode='stale' needs the worker's previous "
+                    "update, which only the federated engine carries; "
+                    "use nan|inf|scale|signflip for gossip")
+            if g.algorithm not in ("dsgd", "fedlcon", "gossip"):
+                raise ValueError(
+                    "corrupt faults need a mixing algorithm to lie "
+                    f"through (dsgd|fedlcon|gossip), not {g.algorithm!r}")
+        if robust_active and g.algorithm == "choco":
+            raise ValueError(
+                "the robust layer does not cover choco's compressed "
+                "exchange; use dsgd|fedlcon|gossip")
+        if robust_active and g.comm_dtype:
+            # The robust consensus paths (clipped_gossip_mix /
+            # byzantine_mix) run full-precision pairwise math and never
+            # consult the wire-compression knob — reject rather than
+            # silently run a different experiment than configured
+            # (mirrors the federated aggregator+comm_dtype reject).
+            raise ValueError(
+                "comm_dtype wire compression only applies to the plain "
+                "consensus collectives; the robust layer (corrupt "
+                "faults / clip_radius / quarantine) runs full-precision "
+                "pairwise mixing — drop one of the two")
+        if (clip_tau > 0 or self._quarantine_on) and g.algorithm == "nocons":
+            # No consensus step means no wire to clip and no screened
+            # signal to quarantine on — reject loudly rather than run
+            # with a defense the user believes is active.
+            raise ValueError(
+                "RobustConfig clip_radius/quarantine need a mixing "
+                "algorithm to act on (dsgd|fedlcon|gossip); "
+                f"{cfg.gossip.algorithm!r} never communicates")
+
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
         l2 = cfg.optim.weight_decay
@@ -356,8 +420,14 @@ class GossipTrainer:
         if g.comm_impl not in ("auto", "dense", "shift"):
             raise ValueError(
                 f"unknown comm_impl {g.comm_impl!r}; one of auto|dense|shift")
+        if g.comm_impl == "shift" and robust_active:
+            raise ValueError(
+                "comm_impl='shift' is incompatible with the robust layer: "
+                "clipped mixing / corrupt sends need the dense pairwise "
+                "path (the 'auto' default picks it)")
         self._shift_ids: tuple[int, ...] | None = None
-        if g.comm_impl != "dense" and self.mixing is not None and (do_mix or is_choco):
+        if (g.comm_impl != "dense" and not robust_active
+                and self.mixing is not None and (do_mix or is_choco)):
             flat_1d = len(mesh.axis_names) == 1
             extra = (0,) if self.faults.affects_matrix else ()
             ids = (schedule_shift_decomposition(self.mixing, max_shifts=None,
@@ -503,17 +573,21 @@ class GossipTrainer:
                 p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
             return p_t, m_t, losses, accs, {}
 
-        def pack_host_metrics(tl, ta, evalm, em):
+        def pack_host_metrics(tl, ta, evalm, em, screened):
             """Everything the host reads per round, as ONE flat f32
             vector — on this hardware every device→host fetch pays a
             fixed ~100 ms tunnel round-trip, so the round's metrics
-            (train loss/acc, fleet-mean eval, and the per-epoch
-            client-history block under the holdout) travel in a single
-            transfer.  Layout (mirrored by ``_unpack_host_metrics``):
-            [tl, ta, mean(acc), mean(loss_mean)] + 4×[W·E] em blocks."""
+            (train loss/acc, fleet-mean eval, the robust layer's
+            screened flags, and the per-epoch client-history block under
+            the holdout) travel in a single transfer.  Layout (mirrored
+            by ``_unpack_host_metrics``): [tl, ta, mean(acc),
+            mean(loss_mean)] + [W] screened (robust runs only) +
+            4×[W·E] em blocks."""
             parts = [tl[None], ta[None],
                      jnp.mean(evalm["acc"])[None],
                      jnp.mean(evalm["loss_mean"])[None]]
+            if robust_active:
+                parts.append(screened)
             if use_holdout:
                 parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
                           em["val_acc"].ravel(),
@@ -521,13 +595,50 @@ class GossipTrainer:
             return jnp.concatenate(
                 [p.astype(jnp.float32) for p in parts])
 
-        def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
-                     bweight, train_x, train_y, ex, ey, ew, vidx, vw,
-                     do_eval):
+        def consensus_phase(params, x_hat, w_matrix, alive, t, cmask):
+            """The round's consensus step, with the Byzantine sends
+            injected and (when clip_tau > 0) clipped.  A liar corrupts
+            only what it BROADCASTS (``x_send``) — its own carried state
+            keeps training honestly, which is the Byzantine model: lies
+            on the wire, not a crashed computation.  Returns (params,
+            x_hat, [W] screened sender flags)."""
+            screened = jnp.zeros(w, jnp.float32)
             if is_choco:
                 params, x_hat = choco_mix(params, x_hat, w_matrix, alive, t)
-            elif do_mix:
-                params = mix_consensus(params, w_matrix)
+                return params, x_hat, screened
+            if not do_mix:
+                return params, x_hat, screened
+            if not robust_active:
+                return mix_consensus(params, w_matrix), x_hat, screened
+            x_send = (corrupt_update(params, cmask, corrupt_mode,
+                                     corrupt_scale)
+                      if has_corrupt else params)
+            if clip_tau > 0:
+                params, screened = clipped_gossip_mix(params, x_send,
+                                                      w_matrix, clip_tau)
+                # FedLCon's extra sweeps re-read honest current states
+                # (the lie already entered — and was clipped — in sweep
+                # one).
+                for _ in range(eps - 1):
+                    params, _ = clipped_gossip_mix(params, params,
+                                                   w_matrix, clip_tau)
+            else:
+                # Undefended mixing of corrupted sends — the
+                # plain-mean-diverges half of the threat model.
+                # Self-terms read honest state (a liar poisons its
+                # NEIGHBORS, not its own computation); FedLCon's extra
+                # sweeps re-mix the already-absorbed result.
+                screened = 1.0 - finite_lane_mask(x_send)
+                params = byzantine_mix(params, x_send, w_matrix)
+                for _ in range(eps - 1):
+                    params = mix_once(params, w_matrix)
+            return params, x_hat, screened
+
+        def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
+                     bweight, train_x, train_y, ex, ey, ew, vidx, vw,
+                     do_eval, cmask=None):
+            params, x_hat, screened = consensus_phase(
+                params, x_hat, w_matrix, alive, t, cmask)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
@@ -542,7 +653,8 @@ class GossipTrainer:
                 p_t = where_mask(alive, p_t, params)
                 m_t = where_mask(alive, m_t, mom)
             tl, ta = train_metrics(losses, accs, alive)
-            return p_t, m_t, x_hat, pack_host_metrics(tl, ta, evalm, em)
+            return p_t, m_t, x_hat, pack_host_metrics(tl, ta, evalm, em,
+                                                      screened)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
@@ -563,22 +675,26 @@ class GossipTrainer:
         local_g, ev = self._local_gather, self._evaluator
 
         def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
-                     is_eval, train_x, train_y, ex, ey, ew, vidx, vw):
+                     is_eval, train_x, train_y, ex, ey, ew, vidx, vw,
+                     cmasks=None):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
             eval (on flagged rounds only) → local epochs — so history
             rows are directly comparable across block settings.  The
             minibatch gather happens inside the step scan from the
-            resident train arrays; compile cost is O(1) in k."""
+            resident train arrays; compile cost is O(1) in k.  Under
+            corrupt faults the per-round corrupt masks ride the scan as
+            one more stacked input."""
 
             def body(carry, xs):
                 p, m, xh = carry
-                w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
-                if is_choco:
-                    p, xh = choco_mix(p, xh, w_t, alive_t, t_t)
-                elif do_mix:
-                    p = mix_consensus(p, w_t)
+                if has_corrupt:
+                    w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t, cm_t = xs
+                else:
+                    w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
+                    cm_t = None
+                p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t, cm_t)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 if use_holdout:
                     p_t, m_t, losses, accs, em = local_phase(
@@ -595,12 +711,14 @@ class GossipTrainer:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                return (p_t, m_t, xh), pack_host_metrics(tl, ta, evalm, em)
+                return (p_t, m_t, xh), pack_host_metrics(tl, ta, evalm, em,
+                                                         scr)
 
+            xs = [w_mats, alive, limits, ts, idx, bw, is_eval]
+            if has_corrupt:
+                xs.append(cmasks)
             (params, mom, x_hat), packed = jax.lax.scan(
-                body, (params, mom, x_hat), (w_mats, alive, limits, ts, idx,
-                                             bw, is_eval)
-            )
+                body, (params, mom, x_hat), tuple(xs))
             return params, mom, x_hat, packed
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
@@ -627,6 +745,7 @@ class GossipTrainer:
                 w_mats = np.stack([p[0] for p in pairs])
                 alive = np.stack([p[1] for p in pairs])
                 limits = np.stack([p[2] for p in pairs])
+                frows = [p[4] for p in pairs]
                 plans = [
                     make_batch_plan(self._train_matrix, batch_size=g.local_bs,
                                     local_ep=g.local_ep, seed=cfg.seed,
@@ -640,17 +759,24 @@ class GossipTrainer:
             is_eval = np.asarray(
                 [(t % self.eval_every) == 0 for t in ts], dtype=bool
             )
+            step_kw = ({"cmasks": jnp.asarray(
+                np.stack([p[3] for p in pairs]))}
+                if self._has_corrupt else {})
             (self.params, self.momentum, self.x_hat,
              packed) = self.timers.measure(
                 "round_step", self._block_fn,
                 self.params, self.momentum, self.x_hat, w_mats, alive,
                 limits, jnp.asarray(ts, jnp.int32), idx, bw,
                 jnp.asarray(is_eval), self._train_x, self._train_y,
-                *self._eval, *self._val,
+                *self._eval, *self._val, **step_kw,
             )
             packed = np.asarray(packed)  # ONE device→host fetch per block
             for j, t in enumerate(ts):
-                tl, ta, acc, lm, em = self._unpack_host_metrics(packed[j])
+                tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
+                    packed[j])
+                if self._robust_active:
+                    self._apply_screen_feedback(t, alive[j], scr, frows[j])
+                self.history.faults.extend(frows[j])
                 row = {
                     "round": t,
                     "avg_train_loss": tl,
@@ -675,18 +801,24 @@ class GossipTrainer:
     def _unpack_host_metrics(self, vec: np.ndarray):
         """Inverse of the round step's ``pack_host_metrics``: one fetched
         f32 vector → (train_loss, train_acc, mean_test_acc,
-        mean_test_loss, em dict of [W, E] arrays or {})."""
+        mean_test_loss, [W] screened flags (robust runs; else None), em
+        dict of [W, E] arrays or {})."""
         tl, ta, acc, lm = (float(vec[0]), float(vec[1]), float(vec[2]),
                            float(vec[3]))
+        off = 4
+        scr = None
+        if self._robust_active:
+            scr = vec[off:off + self.num_workers]
+            off += self.num_workers
         em: dict[str, np.ndarray] = {}
         if self._holdout:
             w, e = self.num_workers, self.cfg.gossip.local_ep
             n = w * e
-            body = vec[4:]
+            body = vec[off:]
             for i, k in enumerate(("train_loss", "train_acc", "val_acc",
                                    "val_loss")):
                 em[k] = body[i * n:(i + 1) * n].reshape(w, e)
-        return tl, ta, acc, lm, em
+        return tl, ta, acc, lm, scr, em
 
     def _append_client_rows(self, t: int, em: dict) -> None:
         """Per-epoch per-worker history rows (P2 Client.history schema,
@@ -712,21 +844,39 @@ class GossipTrainer:
         return np.eye(self.num_workers)
 
     def _round_inputs(
-            self, t: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(mixing argument, alive mask, straggler limits) for round t,
-        with the matrix repaired for any failed workers and every
-        injected fault appended to the ledger (``history.faults``).
+            self, t: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, list]:
+        """(mixing argument, alive mask, straggler limits, corrupt mask,
+        ledger rows) for round t, with the matrix repaired for any
+        failed or quarantined workers.
 
         The mixing argument is the [n, n] matrix on the dense path or
         its [k, n] circulant coefficient table on the shift/ppermute
         path (same math: ``coeffs_for_matrix`` raises if the matrix
         ever leaves the compiled shift set, so the two paths can never
         silently diverge).  Faults are drawn statelessly per round
-        (dopt.faults.FaultPlan), so per-round and blocked execution —
-        and a killed-and-resumed run — see the identical trace."""
+        (dopt.faults.FaultPlan) and ledger rows are RETURNED (not
+        appended) so both execution paths interleave them with the
+        device-side screened rows in the identical order — per-round,
+        blocked, and killed-and-resumed execution log the same trace."""
+        rows: list[dict] = []
         w_t = self._matrix_for_round(t)
         rf = self.faults.for_round(t)
         alive = (~rf.crashed).astype(np.float32)
+        if self._quarantine_on:
+            expired = ((self._quarantine_until != 0)
+                       & (t >= self._quarantine_until))
+            for i in np.nonzero(expired)[0]:
+                rows.append({"round": int(t), "worker": int(i),
+                             "kind": "quarantine", "action": "readmitted"})
+                self._quarantine_until[i] = 0
+                self._screen_streak[i] = 0
+            quarantined = self._quarantine_until > t
+            if quarantined.any():
+                # Quarantine rides the existing alive machinery: the
+                # matrix is repaired around the worker (neighbors stop
+                # listening) and its lane freezes for the span.
+                alive = alive * (~quarantined).astype(np.float32)
         units = self._straggle_units
         limits = FaultPlan.limits_for(rf, units)
         if rf.partition is not None:
@@ -734,20 +884,55 @@ class GossipTrainer:
             # crashed worker is down regardless of which side it is on.
             w_t = repair_for_partition(w_t, rf.partition)
             for i, gid in enumerate(rf.partition):
-                self.history.log_fault(round=t, worker=i, kind="partition",
-                                       action=f"cut_to_group_{int(gid)}")
+                rows.append({"round": int(t), "worker": int(i),
+                             "kind": "partition",
+                             "action": f"cut_to_group_{int(gid)}"})
         if alive.min() < 1.0:
             w_t = repair_for_dropout(w_t, alive)
         for i in np.nonzero(rf.crashed)[0]:
-            self.history.log_fault(round=t, worker=i, kind="crash",
-                                   action="skipped_round")
+            rows.append({"round": int(t), "worker": int(i), "kind": "crash",
+                         "action": "skipped_round"})
         for i in np.nonzero(rf.straggler)[0]:
-            self.history.log_fault(
-                round=t, worker=i, kind="straggler",
-                action=f"truncated_to_{int(limits[i])}_of_{units}")
+            rows.append({"round": int(t), "worker": int(i),
+                         "kind": "straggler",
+                         "action": f"truncated_to_{int(limits[i])}_of_{units}"})
+        cmask = np.zeros(self.num_workers, np.float32)
+        if self._has_corrupt and rf.corrupt is not None:
+            # A down (or quarantined) worker sends nothing to corrupt.
+            liars = rf.corrupt & (alive > 0)
+            cmask = liars.astype(np.float32)
+            mode = self.cfg.faults.corrupt_mode
+            for i in np.nonzero(liars)[0]:
+                rows.append({"round": int(t), "worker": int(i),
+                             "kind": "corrupt",
+                             "action": f"injected_{mode}"})
         if self._shift_ids is not None:
-            return coeffs_for_matrix(w_t, self._shift_ids), alive, limits
-        return w_t.astype(np.float32), alive, limits
+            return (coeffs_for_matrix(w_t, self._shift_ids), alive, limits,
+                    cmask, rows)
+        return w_t.astype(np.float32), alive, limits, cmask, rows
+
+    def _apply_screen_feedback(self, t: int, alive, flags,
+                               rows: list) -> None:
+        """Fold the device step's screened-sender flags (non-finite or
+        majority-clipped broadcasts) into the ledger and the quarantine
+        streaks: K consecutive screened rounds quarantine the worker for
+        ``quarantine_rounds``; one clean alive round resets the
+        streak."""
+        for i in range(self.num_workers):
+            if float(flags[i]) > 0.5:
+                self._screen_streak[i] += 1
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "corrupt", "action": "screened"})
+                if (self._quarantine_on and self._screen_streak[i]
+                        >= self._quarantine_after):
+                    until = int(t) + 1 + self._quarantine_rounds
+                    self._quarantine_until[i] = until
+                    self._screen_streak[i] = 0
+                    rows.append({"round": int(t), "worker": i,
+                                 "kind": "quarantine",
+                                 "action": f"quarantined_until_{until}"})
+            elif float(alive[i]) > 0:
+                self._screen_streak[i] = 0
 
     def run(self, rounds: int | None = None, eps: int | None = None,
             block: int | None = None, checkpoint_every: int = 0,
@@ -771,7 +956,10 @@ class GossipTrainer:
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
         block = g.block_rounds if block is None else block
-        if block > 1:
+        if block > 1 and not self._quarantine_on:
+            # Quarantine stays per-round: the next round's alive mask
+            # depends on THIS round's device-side screen flags, which a
+            # fused block only surfaces at its end.
             return self._run_blocked(rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
@@ -779,7 +967,7 @@ class GossipTrainer:
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                w_t, alive, limits = self._round_inputs(t)
+                w_t, alive, limits, cmask, frows = self._round_inputs(t)
                 plan = make_batch_plan(
                     self._train_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
@@ -787,16 +975,21 @@ class GossipTrainer:
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
+            step_kw = ({"cmask": jnp.asarray(cmask)}
+                       if self._has_corrupt else {})
             (self.params, self.momentum, self.x_hat,
              packed) = self.timers.measure(
                 "round_step", self._round_fn,
                 self.params, self.momentum, self.x_hat, w_t, alive, limits,
                 jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
-                do_eval,
+                do_eval, **step_kw,
             )
-            tl, ta, acc, lm, em = self._unpack_host_metrics(
+            tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
                 np.asarray(packed))  # ONE device→host fetch per round
+            if self._robust_active:
+                self._apply_screen_feedback(t, alive, scr, frows)
+            self.history.faults.extend(frows)
             row = {
                 "round": t,
                 "avg_train_loss": tl,
@@ -833,6 +1026,8 @@ class GossipTrainer:
                   "history": self.history.rows,
                   "client_history": self.client_history.rows,
                   "fault_ledger": self.history.faults,
+                  "screen_streak": self._screen_streak.tolist(),
+                  "quarantine_until": self._quarantine_until.tolist(),
                   "matching_rng_state": self._matching_rng.bit_generator.state},
         )
 
@@ -858,6 +1053,11 @@ class GossipTrainer:
         self.history.rows = list(meta.get("history", []))
         self.history.faults = list(meta.get("fault_ledger", []))
         self.client_history.rows = list(meta.get("client_history", []))
+        w = self.num_workers
+        self._screen_streak = np.asarray(
+            meta.get("screen_streak", [0] * w), np.int64)
+        self._quarantine_until = np.asarray(
+            meta.get("quarantine_until", [0] * w), np.int64)
         if meta.get("matching_rng_state"):
             self._matching_rng.bit_generator.state = meta["matching_rng_state"]
         if meta.get("dropout_rng_state"):

@@ -21,6 +21,12 @@ fails when:
   rounds.  Gossip: cross-group mixing edges are cut and the matrix
   repaired as data (``dopt.topology.repair_for_partition``).
   Federated: only group 0 can reach the server.
+* **Corruption** — the Byzantine model: a worker that LIES rather than
+  dies.  Its contributed update (federated) / broadcast state (gossip)
+  is replaced by NaN/Inf poison, a norm blow-up, a sign flip, or a
+  stale replay (``corrupt_update``, injected inside the jitted round
+  functions).  The defense side lives in ``dopt.robust``: non-finite
+  screening, robust aggregators, clipped gossip, quarantine.
 
 Every draw is keyed **statelessly** by (seed, kind, round) — no RNG
 state is carried between rounds — which is what makes fault traces
@@ -47,9 +53,18 @@ from dopt.utils.prng import host_rng
 # Salt namespace for the fault streams (distinct from the engines'
 # sampling/matching salts so enabling faults never perturbs them).
 _FAULT_SALT = 0xFA010
-_CRASH, _STRAGGLE, _PARTITION = 1, 2, 3
+_CRASH, _STRAGGLE, _PARTITION, _CORRUPT = 1, 2, 3, 4
 
-KINDS = ("crash", "straggler", "partition", "overselect")
+KINDS = ("crash", "straggler", "partition", "overselect", "corrupt",
+         "quarantine")
+CORRUPT_MODES = ("nan", "inf", "scale", "signflip", "stale")
+
+# The GossipConfig.dropout alias predates FaultPlan; warn once per
+# construction that FaultConfig(crash=p) is the spelling that survives.
+_DROPOUT_DEPRECATION = (
+    "GossipConfig.dropout is deprecated: set "
+    "ExperimentConfig.faults=FaultConfig(crash=p) instead (identical "
+    "fault trace; dropout will be removed in a future release)")
 
 
 @dataclass(frozen=True)
@@ -59,18 +74,21 @@ class RoundFaults:
     ``crashed``/``straggler`` are bool [W]; ``epoch_frac`` is float32
     [W] (1.0 for healthy workers, ``straggle_frac`` for stragglers);
     ``partition`` is an int32 [W] group-id vector, or None when no
-    partition is active this round."""
+    partition is active this round; ``corrupt`` is bool [W] (the
+    round's Byzantine liars — None on plans predating the field)."""
 
     round: int
     crashed: np.ndarray
     straggler: np.ndarray
     epoch_frac: np.ndarray
     partition: np.ndarray | None
+    corrupt: np.ndarray | None = None
 
     @property
     def any_fault(self) -> bool:
         return (bool(self.crashed.any()) or bool(self.straggler.any())
-                or self.partition is not None)
+                or self.partition is not None
+                or (self.corrupt is not None and bool(self.corrupt.any())))
 
 
 class FaultPlan:
@@ -89,6 +107,10 @@ class FaultPlan:
                 "set faults via FaultConfig OR the legacy "
                 "GossipConfig.dropout alias, not both")
         if cfg is None and dropout > 0.0:
+            import warnings
+
+            warnings.warn(_DROPOUT_DEPRECATION, DeprecationWarning,
+                          stacklevel=2)
             cfg = FaultConfig(crash=float(dropout))
         if cfg is not None:
             validate_fault_config(cfg)
@@ -103,11 +125,17 @@ class FaultPlan:
     def active(self) -> bool:
         c = self.cfg
         return c is not None and (c.crash > 0 or c.straggle > 0
-                                  or c.partition > 0)
+                                  or c.partition > 0 or c.corrupt > 0)
 
     @property
     def may_straggle(self) -> bool:
         return self.active and self.cfg.straggle > 0
+
+    @property
+    def has_corrupt(self) -> bool:
+        """Byzantine corruption possible (keys the engines' compiled
+        corrupt-injection inputs, like may_straggle keys the limits)."""
+        return self.active and self.cfg.corrupt > 0
 
     @property
     def affects_matrix(self) -> bool:
@@ -124,7 +152,7 @@ class FaultPlan:
         none = np.zeros(w, bool)
         if not self.active:
             return RoundFaults(int(t), none, none, np.ones(w, np.float32),
-                               None)
+                               None, none)
         c = self.cfg
         crashed = (self._rng(_CRASH, t).random(w) < c.crash
                    if c.crash > 0 else none)
@@ -133,8 +161,19 @@ class FaultPlan:
         straggler = straggler & ~crashed   # a crashed worker cannot straggle
         frac = np.where(straggler, np.float32(c.straggle_frac),
                         np.float32(1.0)).astype(np.float32)
+        corrupt = none
+        if c.corrupt > 0:
+            corrupt = self._rng(_CORRUPT, t).random(w) < c.corrupt
+            corrupt &= ~crashed   # a down worker sends nothing to corrupt
+            if c.corrupt_max > 0 and int(corrupt.sum()) > c.corrupt_max:
+                # Cap keeps the LOWEST-INDEXED liars, so corrupt=1.0 +
+                # corrupt_max=f pins workers 0..f-1 as the persistent
+                # adversary set (the fixed-f Byzantine setting).
+                keep = np.nonzero(corrupt)[0][:c.corrupt_max]
+                corrupt = np.zeros(w, bool)
+                corrupt[keep] = True
         return RoundFaults(int(t), crashed, straggler, frac,
-                           self._partition_for_round(t))
+                           self._partition_for_round(t), corrupt)
 
     def _partition_for_round(self, t: int) -> np.ndarray | None:
         """Partition active at t ⇔ one started at some s ∈ (t−span, t];
@@ -194,6 +233,20 @@ def validate_fault_config(cfg: FaultConfig) -> None:
         raise ValueError("FaultConfig.partition_span must be >= 1")
     if cfg.partition_groups < 2:
         raise ValueError("FaultConfig.partition_groups must be >= 2")
+    if not 0.0 <= cfg.corrupt <= 1.0:
+        raise ValueError(
+            f"FaultConfig.corrupt={cfg.corrupt} must be in [0, 1]")
+    if cfg.corrupt_mode not in CORRUPT_MODES:
+        raise ValueError(
+            f"unknown corrupt_mode {cfg.corrupt_mode!r}; one of "
+            f"{CORRUPT_MODES}")
+    if not np.isfinite(cfg.corrupt_scale) or cfg.corrupt_scale == 0.0:
+        raise ValueError(
+            f"FaultConfig.corrupt_scale={cfg.corrupt_scale} must be a "
+            "finite nonzero factor (use corrupt_mode='inf' for "
+            "non-finite poison)")
+    if cfg.corrupt_max < 0:
+        raise ValueError("FaultConfig.corrupt_max must be >= 0")
 
 
 def parse_fault_spec(spec: str) -> FaultConfig:
@@ -227,3 +280,103 @@ def parse_fault_spec(spec: str) -> FaultConfig:
     cfg = FaultConfig(**kw)
     validate_fault_config(cfg)
     return cfg
+
+
+# CLI --corrupt shorthand: short keys -> FaultConfig field names.
+_CORRUPT_KEYS = {"p": "corrupt", "mode": "corrupt_mode",
+                 "scale": "corrupt_scale", "max": "corrupt_max"}
+
+
+def parse_corrupt_spec(spec: str, base: FaultConfig | None = None) -> FaultConfig:
+    """CLI ``--corrupt`` spec, merged onto an existing FaultConfig.
+
+    e.g. ``--corrupt "p=0.25,mode=signflip,scale=50,max=2"`` or the bare
+    probability ``--corrupt 0.25``.  Keys map onto the FaultConfig
+    corrupt_* fields, so crash/straggler faults from ``--faults``
+    compose with the Byzantine knobs."""
+    kw: dict[str, object] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, raw = part.partition("=")
+        if not eq:
+            try:
+                kw["corrupt"] = float(part)
+                continue
+            except ValueError:
+                raise ValueError(
+                    f"--corrupt: expected a probability or key=value, "
+                    f"got {part!r}")
+        key = key.strip()
+        if key not in _CORRUPT_KEYS:
+            raise ValueError(
+                f"--corrupt: unknown field {key!r}; one of "
+                f"{sorted(_CORRUPT_KEYS)}")
+        field = _CORRUPT_KEYS[key]
+        try:
+            if field == "corrupt_mode":
+                kw[field] = raw.strip()
+            elif field == "corrupt_max":
+                kw[field] = int(raw)
+            else:
+                kw[field] = float(raw)
+        except ValueError:
+            raise ValueError(f"--corrupt: bad value {raw!r} for {key!r}")
+    if "corrupt" not in kw and (base is None or base.corrupt == 0.0):
+        kw.setdefault("corrupt", 1.0)   # --corrupt "mode=nan" means "lie"
+    cfg = dataclasses.replace(base or FaultConfig(), **kw)
+    validate_fault_config(cfg)
+    return cfg
+
+
+def corrupt_update(update, cmask, mode: str, scale: float,
+                   ref=None, prev=None):
+    """Inject the round's Byzantine corruption into a stacked update —
+    jittable, so corrupted runs stay bit-reproducible and blocked /
+    compact / resumed execution injects identically.
+
+    ``update`` is the [lanes, ...] stacked pytree a worker contributes
+    (post-local-training params in the federated engine, the broadcast
+    state in gossip); ``cmask`` the [lanes] 0/1 corrupt mask (data — the
+    fault-free mask compiles to a no-op select).  ``ref`` is the
+    reference point updates are measured from (theta in the federated
+    engine; None = the origin, the gossip case), ``prev`` the previous
+    update for mode='stale' (the carried lane state).
+
+    Modes: 'nan'/'inf' poison the lanes outright; 'scale' blows the
+    update up by ``scale`` around ``ref``; 'signflip' reflects it
+    through ``ref``; 'stale' replays ``prev``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dopt.parallel.collectives import where_mask
+
+    if mode == "nan":
+        bad = jax.tree.map(lambda x: jnp.full_like(x, jnp.nan), update)
+    elif mode == "inf":
+        bad = jax.tree.map(lambda x: jnp.full_like(x, jnp.inf), update)
+    elif mode == "scale":
+        if ref is None:
+            bad = jax.tree.map(lambda x: (x * jnp.asarray(scale, x.dtype)),
+                               update)
+        else:
+            bad = jax.tree.map(
+                lambda x, r: r + jnp.asarray(scale, x.dtype) * (x - r),
+                update, ref)
+    elif mode == "signflip":
+        if ref is None:
+            bad = jax.tree.map(lambda x: -x, update)
+        else:
+            bad = jax.tree.map(lambda x, r: (2 * r - x).astype(x.dtype),
+                               update, ref)
+    elif mode == "stale":
+        if prev is None:
+            raise ValueError("corrupt_mode='stale' needs the previous "
+                             "update (prev=...)")
+        bad = prev
+    else:
+        raise ValueError(f"unknown corrupt_mode {mode!r}; one of "
+                         f"{CORRUPT_MODES}")
+    return where_mask(cmask, bad, update)

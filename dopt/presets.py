@@ -21,7 +21,7 @@ import dataclasses
 
 from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
                          FederatedConfig, GossipConfig, ModelConfig,
-                         OptimizerConfig, SeqLMConfig)
+                         OptimizerConfig, RobustConfig, SeqLMConfig)
 
 MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
 CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
@@ -245,6 +245,30 @@ PRESETS = {
         name="baseline1-ring-mnist-mlp-faulty",
         faults=FaultConfig(crash=0.1, straggle=0.2, straggle_frac=0.5,
                            partition=0.05, partition_span=2)),
+    # Byzantine variants (dopt.faults corrupt kind + dopt.robust): the
+    # same workloads with workers that LIE rather than die.  Federated:
+    # 3 persistent sign-flipping adversaries (corrupt=1, corrupt_max=3
+    # pins workers 0..2) against a coordinate-wise trimmed mean — no
+    # quarantine knob, because the federated detection signal is the
+    # non-finite screen and sign-flipped updates are finite (it would
+    # never fire, while still forcing per-round execution).  Gossip:
+    # a scale-mode liar against clipped gossip, where the
+    # majority-clipped detection DOES catch finite lies, with a
+    # 3-strike quarantine benching it.  Swap the defense with
+    # --aggregator / --set robust.*.
+    "baseline3-byzantine": lambda: dataclasses.replace(
+        baseline_3_fedavg_noniid(),
+        name="baseline3-fedavg16-byzantine",
+        faults=FaultConfig(corrupt=1.0, corrupt_max=3,
+                           corrupt_mode="signflip", corrupt_scale=10.0),
+        robust=RobustConfig(aggregator="trimmed_mean", trim_frac=0.25)),
+    "baseline1-byzantine": lambda: dataclasses.replace(
+        baseline_1_ring_mnist_mlp(),
+        name="baseline1-ring-mnist-mlp-byzantine",
+        faults=FaultConfig(corrupt=1.0, corrupt_max=1,
+                           corrupt_mode="scale", corrupt_scale=50.0),
+        robust=RobustConfig(clip_radius=1.0, quarantine_after=3,
+                            quarantine_rounds=5)),
 }
 
 

@@ -123,6 +123,23 @@ def main(argv: list[str] | None = None) -> int:
                          "'crash=0.1,straggle=0.2,straggle_frac=0.5,"
                          "partition=0.05'; every injected fault is recorded "
                          "in the run's fault ledger")
+    ap.add_argument("--corrupt", default=None, metavar="SPEC",
+                    help="inject Byzantine corruption (workers that LIE): "
+                         "'p=0.25,mode=signflip,scale=50,max=2' or a bare "
+                         "probability; merges onto --faults so crash and "
+                         "corruption compose.  modes: nan|inf|scale|"
+                         "signflip|stale; with p=1 'max=f' pins workers "
+                         "0..f-1 as persistent adversaries")
+    ap.add_argument("--aggregator", default=None,
+                    choices=["mean", "trimmed_mean", "median", "krum",
+                             "multi_krum"],
+                    help="Byzantine-robust aggregation (dopt.robust): how "
+                         "the federated server combines surviving updates "
+                         "(default mean).  Tune the knobs with --set "
+                         "robust.trim_frac=... etc.; the gossip engine's "
+                         "defense is clipped gossip: pass "
+                         "'--aggregator mean --set robust.clip_radius=R' "
+                         "(the flag installs the robust section)")
     ap.add_argument("--faults-json", default=None, metavar="PATH",
                     help="write the run's fault ledger here as JSON")
     ap.add_argument("--timers", action="store_true",
@@ -149,6 +166,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     cfg = get_preset(args.preset)
+    if args.aggregator:
+        # Installed BEFORE --set so `--aggregator krum --set
+        # robust.krum_f=2` works on presets without a robust section.
+        from dopt.config import RobustConfig
+
+        base = cfg.robust or RobustConfig()
+        cfg = cfg.replace(
+            robust=dataclasses.replace(base, aggregator=args.aggregator))
     for spec in args.overrides:
         cfg = apply_override(cfg, spec)
     if args.faults:
@@ -156,6 +181,14 @@ def main(argv: list[str] | None = None) -> int:
 
         try:
             cfg = cfg.replace(faults=parse_fault_spec(args.faults))
+        except ValueError as e:
+            raise SystemExit(str(e))
+    if args.corrupt:
+        from dopt.faults import parse_corrupt_spec
+
+        try:
+            cfg = cfg.replace(
+                faults=parse_corrupt_spec(args.corrupt, base=cfg.faults))
         except ValueError as e:
             raise SystemExit(str(e))
     if cfg.faults is not None and (cfg.seqlm is not None

@@ -13,9 +13,33 @@ byte-compatible with the committed result files' column layout.
 from __future__ import annotations
 
 import csv
+import io
 import json
+import os
 from pathlib import Path
 from typing import Any, Iterator
+
+
+def atomic_write_text(path: str | Path, text: str,
+                      newline: str | None = None) -> Path:
+    """Crash-safe file write: materialise into a same-directory temp
+    file, then ``os.replace`` into place (atomic on POSIX).  A process
+    killed mid-write leaves either the previous complete file or
+    nothing — never a truncated artifact — matching the size-manifest
+    hardening of ``dopt.utils.checkpoint``.  All History exports
+    (results CSV/JSON, the ``--faults-json`` ledger) go through here.
+    ``newline`` passes through to the write (the csv module's content
+    carries its own ``\\r\\n`` terminators — pass ``""`` to keep them
+    byte-exact instead of letting text mode re-translate)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
+    try:
+        tmp.write_text(text, newline=newline)
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return path
 
 
 class History:
@@ -41,10 +65,7 @@ class History:
                             "kind": str(kind), "action": str(action)})
 
     def faults_to_json(self, path: str | Path) -> Path:
-        path = Path(path)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(json.dumps(self.faults, indent=2))
-        return path
+        return atomic_write_text(path, json.dumps(self.faults, indent=2))
 
     def __len__(self) -> int:
         return len(self.rows)
@@ -72,27 +93,23 @@ class History:
     def to_csv(self, path: str | Path) -> Path:
         """Write rows in the reference results/*.csv layout (leading
         unnamed index column, then the columns — union over ALL rows,
-        since non-eval rounds carry fewer keys than eval rounds)."""
-        path = Path(path)
-        path.parent.mkdir(parents=True, exist_ok=True)
+        since non-eval rounds carry fewer keys than eval rounds).
+        Written atomically (``atomic_write_text``)."""
         seen: dict[str, None] = {}
         for r in self.rows:
             for k in r:
                 seen.setdefault(k)
         cols = [c for c in self._CSV_ORDER if c in seen]
         cols += [c for c in seen if c not in cols]
-        with open(path, "w", newline="") as f:
-            w = csv.writer(f)
-            w.writerow([""] + cols)
-            for i, r in enumerate(self.rows):
-                w.writerow([i] + [r.get(c, "") for c in cols])
-        return path
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        w.writerow([""] + cols)
+        for i, r in enumerate(self.rows):
+            w.writerow([i] + [r.get(c, "") for c in cols])
+        return atomic_write_text(path, buf.getvalue(), newline="")
 
     def to_json(self, path: str | Path) -> Path:
-        path = Path(path)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(json.dumps(self.rows, indent=2))
-        return path
+        return atomic_write_text(path, json.dumps(self.rows, indent=2))
 
     @classmethod
     def from_csv(cls, path: str | Path, name: str = "history") -> "History":

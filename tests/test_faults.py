@@ -81,10 +81,32 @@ def test_faultplan_inactive_and_fault_free():
 
 
 def test_faultplan_dropout_alias():
-    plan = FaultPlan(8, None, seed=5, dropout=0.25)
+    with pytest.warns(DeprecationWarning, match="dropout is deprecated"):
+        plan = FaultPlan(8, None, seed=5, dropout=0.25)
     assert plan.active and plan.cfg.crash == 0.25
     with pytest.raises(ValueError, match="not both"):
         FaultPlan(8, FaultConfig(crash=0.1), seed=5, dropout=0.25)
+
+
+def test_dropout_alias_deprecation_and_trace_parity(devices):
+    # Retirement contract for the GossipConfig.dropout alias: trainer
+    # construction warns ONCE (DeprecationWarning), and the run's
+    # History + fault ledger are identical to the explicit
+    # FaultConfig(crash=p) spelling — so the alias can be dropped in a
+    # later PR with a pure find-and-replace migration.
+    import warnings
+
+    from dopt.engine import GossipTrainer
+
+    with pytest.warns(DeprecationWarning, match="dropout is deprecated"):
+        legacy = GossipTrainer(_gossip_cfg(None, dropout=0.3))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        explicit = GossipTrainer(_gossip_cfg(FaultConfig(crash=0.3)))
+    hl = legacy.run(rounds=2)
+    he = explicit.run(rounds=2)
+    assert hl.rows == he.rows
+    assert hl.faults == he.faults and hl.faults
 
 
 @pytest.mark.parametrize("bad", [
@@ -146,7 +168,8 @@ def test_parse_fault_spec():
         parse_fault_spec("crush=0.1")
     with pytest.raises(ValueError, match="expects"):
         parse_fault_spec("crash=lots")
-    assert set(KINDS) == {"crash", "straggler", "partition", "overselect"}
+    assert set(KINDS) == {"crash", "straggler", "partition", "overselect",
+                          "corrupt", "quarantine"}
 
 
 # ---------------------------------------------------------------------------

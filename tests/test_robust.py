@@ -1,0 +1,592 @@
+"""Byzantine corruption & robust-aggregation tests (dopt.robust).
+
+Four layers, all inside the tier-1 budget (tiny MLPs, <= 6 rounds):
+
+* aggregator unit properties — trimmed mean / median / Krum against
+  hand-computed masked statistics, outlier resistance, the non-finite
+  lane screen, norm clipping, clipped-gossip algebra;
+* the convergence acceptance criterion — under a corrupt FaultPlan with
+  f adversaries, trimmed-mean/median/Krum (federated) and clipped
+  gossip stay within 2x of the fault-free baseline while the plain mean
+  diverges or NaNs;
+* engine integration — clean-path bit-identity, the always-on
+  non-finite guard on the default mean path, execution-path parity
+  (compact/full-width, per-round/blocked) under corruption, and the
+  quarantine lifecycle surviving checkpoint/resume bit-exactly;
+* artifact hardening — atomic History/ledger writes survive a
+  simulated mid-write kill.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig, RobustConfig)
+from dopt.faults import CORRUPT_MODES, FaultPlan, parse_corrupt_spec
+from dopt.robust import (clip_to_ball, clipped_gossip_mix, finite_lane_mask,
+                         krum_aggregate, masked_median, masked_trimmed_mean,
+                         validate_robust_config)
+
+pytestmark = pytest.mark.byzantine
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: corrupt draws
+# ---------------------------------------------------------------------------
+
+def test_corrupt_draws_stateless_and_capped():
+    cfg = FaultConfig(corrupt=0.5, corrupt_mode="signflip")
+    a, b = FaultPlan(16, cfg, seed=3), FaultPlan(16, cfg, seed=3)
+    for t in (4, 0, 4, 2):
+        np.testing.assert_array_equal(a.for_round(t).corrupt,
+                                      b.for_round(t).corrupt)
+    assert a.has_corrupt and a.active
+    # corrupt=1 + corrupt_max=f pins workers 0..f-1 as the persistent
+    # adversary set (the fixed-f Byzantine setting).
+    pinned = FaultPlan(16, FaultConfig(corrupt=1.0, corrupt_max=3), seed=0)
+    for t in range(4):
+        np.testing.assert_array_equal(
+            np.nonzero(pinned.for_round(t).corrupt)[0], [0, 1, 2])
+
+
+def test_corrupt_crash_ties_and_validation():
+    # A crashed worker sends nothing — crash wins the tie.
+    rf = FaultPlan(8, FaultConfig(corrupt=1.0, crash=1.0), seed=0).for_round(0)
+    assert rf.crashed.all() and not rf.corrupt.any()
+    for bad in ({"corrupt": 1.5}, {"corrupt_mode": "gaslight"},
+                {"corrupt_scale": 0.0}, {"corrupt_max": -1}):
+        with pytest.raises(ValueError):
+            FaultPlan(8, FaultConfig(**bad), seed=0)
+
+
+def test_parse_corrupt_spec():
+    cfg = parse_corrupt_spec("p=0.25,mode=signflip,scale=50,max=2")
+    assert cfg.corrupt == 0.25 and cfg.corrupt_mode == "signflip"
+    assert cfg.corrupt_scale == 50 and cfg.corrupt_max == 2
+    assert parse_corrupt_spec("0.4").corrupt == 0.4
+    # bare mode spec implies p=1 ("make them lie")
+    assert parse_corrupt_spec("mode=nan").corrupt == 1.0
+    base = FaultConfig(crash=0.1)
+    merged = parse_corrupt_spec("p=0.2", base=base)
+    assert merged.crash == 0.1 and merged.corrupt == 0.2
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_corrupt_spec("prob=0.2")
+    assert set(CORRUPT_MODES) == {"nan", "inf", "scale", "signflip", "stale"}
+
+
+# ---------------------------------------------------------------------------
+# Robust aggregator units (host-level, jit-free semantics)
+# ---------------------------------------------------------------------------
+
+def _tree(x):
+    return {"w": np.asarray(x, np.float32)}
+
+
+def test_finite_lane_mask():
+    x = {"a": np.ones((4, 3), np.float32),
+         "b": np.ones((4, 2), np.float32)}
+    x["a"][1, 0] = np.nan
+    x["b"][3, 1] = np.inf
+    np.testing.assert_array_equal(np.asarray(finite_lane_mask(x)),
+                                  [1.0, 0.0, 1.0, 0.0])
+
+
+def test_trimmed_mean_matches_manual_and_resists_outliers():
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(10, 5)).astype(np.float32)
+    mask = np.ones(10, np.float32)
+    mask[7] = 0.0                       # one dead lane, excluded entirely
+    vals[7] = 1e9                       # ...whatever it holds is ignored
+    vals[0] = 1e6                       # one live outlier, trimmed
+    out = np.asarray(masked_trimmed_mean(_tree(vals), mask, 0.2)["w"])
+    alive = np.delete(vals, 7, axis=0)
+    k = int(0.2 * 9)                    # floor(trim_frac * n_alive)
+    manual = np.sort(alive, axis=0)[k:9 - k].mean(axis=0)
+    np.testing.assert_allclose(out, manual, rtol=1e-5)
+    assert np.abs(out).max() < 10       # the 1e6 outlier never leaks
+
+
+def test_median_matches_manual_odd_and_even():
+    rng = np.random.default_rng(1)
+    vals = rng.normal(size=(9, 4)).astype(np.float32)
+    mask = np.ones(9, np.float32)
+    out = np.asarray(masked_median(_tree(vals), mask)["w"])
+    np.testing.assert_allclose(out, np.median(vals, axis=0), rtol=1e-5)
+    mask[4] = 0.0                       # even alive count -> mid-pair mean
+    out = np.asarray(masked_median(_tree(vals), mask)["w"])
+    np.testing.assert_allclose(out, np.median(np.delete(vals, 4, 0), axis=0),
+                               rtol=1e-5)
+
+
+def test_krum_selects_honest_cluster():
+    rng = np.random.default_rng(2)
+    honest = rng.normal(0.0, 0.1, size=(6, 8)).astype(np.float32)
+    liars = rng.normal(50.0, 0.1, size=(2, 8)).astype(np.float32)
+    vals = np.concatenate([honest, liars])
+    mask = np.ones(8, np.float32)
+    out = np.asarray(krum_aggregate(_tree(vals), mask, 2, 1)["w"])
+    # Krum picks ONE honest update — never a mixture touched by liars.
+    assert np.abs(out).max() < 1.0
+    assert any(np.allclose(out, h, atol=1e-6) for h in honest)
+    # multi-Krum (m=0 -> n_alive - f = 6) averages the honest cluster.
+    out_m = np.asarray(krum_aggregate(_tree(vals), mask, 2, 0)["w"])
+    np.testing.assert_allclose(out_m, honest.mean(axis=0), atol=1e-4)
+    # dead lanes can't be selected even when closest together
+    mask2 = np.ones(8, np.float32)
+    mask2[6:] = 0.0
+    out_d = np.asarray(krum_aggregate(_tree(vals), mask2, 1, 1)["w"])
+    assert np.abs(out_d).max() < 1.0
+    # degenerate round: a lone survivor at a nonzero index (every score
+    # is the +inf sentinel) must return ITS value, not zeros
+    mask3 = np.zeros(8, np.float32)
+    mask3[3] = 1.0
+    out_s = np.asarray(krum_aggregate(_tree(vals), mask3, 2, 1)["w"])
+    np.testing.assert_allclose(out_s, vals[3], rtol=1e-6)
+
+
+def test_clip_to_ball_bounds_deviations():
+    center = {"w": np.zeros(4, np.float32)}
+    x = {"w": np.stack([np.full(4, 100.0, np.float32),
+                        np.full(4, 0.1, np.float32)])}
+    out = np.asarray(clip_to_ball(x, center, 1.0)["w"])
+    assert np.linalg.norm(out[0]) <= 1.0 + 1e-5     # blown lane clipped
+    np.testing.assert_allclose(out[1], 0.1, rtol=1e-5)  # inlier untouched
+
+
+def test_clipped_gossip_reduces_to_plain_mix_and_ignores_nan():
+    from dopt.parallel.collectives import mix_dense
+    from dopt.topology import build_mixing_matrices
+
+    rng = np.random.default_rng(3)
+    w_m = build_mixing_matrices("circle", "metropolis", 6, seed=0).matrices[0]
+    x = {"w": rng.normal(size=(6, 5)).astype(np.float32)}
+    # tau far above any deviation: exactly the plain consensus step
+    mixed, screened = clipped_gossip_mix(x, x, w_m, 1e9)
+    np.testing.assert_allclose(np.asarray(mixed["w"]),
+                               np.asarray(mix_dense(x, w_m)["w"]), atol=1e-5)
+    assert not np.asarray(screened).any()
+    # a NaN sender is ignored outright (its mixing weight returns to
+    # each receiver's self-term), and the liar is the one flagged.
+    x_send = {"w": x["w"].copy()}
+    x_send["w"][2] = np.nan
+    mixed, screened = clipped_gossip_mix(x, x_send, w_m, 1e9)
+    assert np.isfinite(np.asarray(mixed["w"])).all()
+    np.testing.assert_array_equal(np.asarray(screened),
+                                  [0, 0, 1, 0, 0, 0])
+    c = w_m * (1.0 - np.eye(6))
+    c[:, 2] = 0.0                       # the poisoned column is dropped
+    manual = (np.diag(1.0 - c.sum(axis=1)) + c) @ x["w"]
+    np.testing.assert_allclose(np.asarray(mixed["w"])[np.arange(6) != 2],
+                               manual[np.arange(6) != 2], atol=1e-5)
+    # a norm-blown sender shifts each honest receiver by at most
+    # 2·W_ij·tau relative to the honest sweep (its own clipped term
+    # plus the honest term it displaced)
+    x_send2 = {"w": x["w"].copy()}
+    x_send2["w"][2] += 1e6
+    tau = 0.5
+    mixed2, screened2 = clipped_gossip_mix(x, x_send2, w_m, tau)
+    honest_mix, _ = clipped_gossip_mix(x, x, w_m, tau)
+    delta = np.linalg.norm(np.asarray(mixed2["w"]) - np.asarray(honest_mix["w"]),
+                           axis=1)
+    assert (delta <= 2 * w_m[:, 2] * tau + 1e-4).all()
+    assert screened2[2] == 1.0
+
+
+def test_byzantine_mix_spreads_to_neighbors_only_and_spares_liar():
+    from dopt.robust import byzantine_mix
+    from dopt.parallel.collectives import mix_dense
+    from dopt.topology import build_mixing_matrices
+
+    rng = np.random.default_rng(4)
+    w_m = build_mixing_matrices("circle", "metropolis", 6, seed=0).matrices[0]
+    x = {"w": rng.normal(size=(6, 5)).astype(np.float32)}
+    # honest sends: exactly the dense consensus step
+    np.testing.assert_allclose(
+        np.asarray(byzantine_mix(x, x, w_m)["w"]),
+        np.asarray(mix_dense(x, w_m)["w"]), atol=1e-5)
+    # a NaN liar at lane 2 poisons exactly its ring neighbors (1, 3);
+    # its OWN carried state stays finite (it lied on the wire only)
+    x_send = {"w": x["w"].copy()}
+    x_send["w"][2] = np.nan
+    out = np.asarray(byzantine_mix(x, x_send, w_m)["w"])
+    finite_rows = np.isfinite(out).all(axis=1)
+    np.testing.assert_array_equal(finite_rows, [1, 0, 1, 0, 1, 1])
+
+
+def test_validate_robust_config():
+    validate_robust_config(RobustConfig())
+    for bad in ({"aggregator": "mode"}, {"trim_frac": 0.5},
+                {"krum_f": -1}, {"clip_radius": -1.0},
+                {"quarantine_rounds": 0}):
+        with pytest.raises(ValueError):
+            validate_robust_config(RobustConfig(**bad))
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (tiny models, synthetic data)
+# ---------------------------------------------------------------------------
+
+_DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
+                   synthetic_train_size=256, synthetic_test_size=64)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5, rho=0.1)
+# 2 persistent adversaries blowing their update norm up 50x each round.
+_ATTACK = FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode="scale",
+                      corrupt_scale=50.0)
+
+
+def _fed_cfg(faults=None, robust=None, **fkw):
+    f = dict(algorithm="fedavg", frac=1.0, rounds=4, local_ep=1, local_bs=32)
+    f.update(fkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, federated=FederatedConfig(**f),
+                            faults=faults, robust=robust)
+
+
+def _gossip_cfg(faults=None, robust=None, **gkw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=32)
+    g.update(gkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, gossip=GossipConfig(**g),
+                            faults=faults, robust=robust)
+
+
+def test_clean_paths_bit_identical_with_robust_defaults(devices):
+    # robust=None vs all-default RobustConfig (aggregator='mean', no
+    # clip, no quarantine): identical History on both engines — the
+    # acceptance criterion that wiring the robust layer never perturbs
+    # clean runs.
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    h0 = FederatedTrainer(_fed_cfg(frac=0.5)).run(rounds=1)
+    h1 = FederatedTrainer(_fed_cfg(frac=0.5, robust=RobustConfig())).run(rounds=1)
+    assert h0.rows == h1.rows and h1.faults == []
+    g0 = GossipTrainer(_gossip_cfg()).run(rounds=1)
+    g1 = GossipTrainer(_gossip_cfg(robust=RobustConfig())).run(rounds=1)
+    assert g0.rows == g1.rows and g1.faults == []
+
+
+def test_nan_lane_no_longer_poisons_global_loss(devices):
+    # Regression for the non-finite guard on the DEFAULT mean path: a
+    # worker emitting NaN updates is screened (ledger corrupt/screened)
+    # and every global metric stays finite.  Pre-guard, one NaN lane
+    # NaN'd theta — and the global loss — from its first round on.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan")
+    tr = FederatedTrainer(_fed_cfg(fc))
+    h = tr.run(rounds=3)
+    for row in h.rows:
+        for k in ("test_loss", "test_acc", "train_loss", "local_loss"):
+            assert np.isfinite(row[k]), (k, row)
+    acts = {(r["kind"], r["action"]) for r in h.faults}
+    assert ("corrupt", "injected_nan") in acts
+    assert ("corrupt", "screened_nonfinite") in acts
+    assert np.isfinite(tr.evaluate_global()["loss_mean"])
+
+
+# The fault-free and mean-under-attack reference runs are shared by
+# every aggregator case (identical configs -> identical deterministic
+# results) — memoized so the tier-1 sweep pays for them once.
+_LOSS_MEMO: dict = {}
+
+
+def _final_test_loss(key, cfg):
+    if key not in _LOSS_MEMO:
+        from dopt.engine import FederatedTrainer
+
+        _LOSS_MEMO[key] = FederatedTrainer(cfg).run(
+            rounds=4).rows[-1]["test_loss"]
+    return _LOSS_MEMO[key]
+
+
+@pytest.mark.parametrize("aggregator", [
+    "trimmed_mean", "median",
+    pytest.param("krum", marks=pytest.mark.slow),
+    pytest.param("multi_krum", marks=pytest.mark.slow),
+])
+def test_robust_aggregators_converge_where_mean_diverges(aggregator, devices):
+    # THE acceptance criterion: with f=2 adversaries out of 8, each
+    # robust aggregator ends within 2x of its fault-free baseline's
+    # eval loss; the plain mean diverges (or NaNs) by orders of
+    # magnitude.  Fully deterministic (seeded corrupt draws, frac=1).
+    # The averaging aggregators are held to the plain-mean baseline;
+    # Krum selects a SINGLE update per round — its information cost is
+    # paid with or without an attack — so its tolerance is measured
+    # against its own fault-free trajectory (plus a same-order sanity
+    # bound vs the plain baseline).
+    base = _final_test_loss("base", _fed_cfg())
+    mean_loss = _final_test_loss("mean_attack", _fed_cfg(_ATTACK))
+    assert not np.isfinite(mean_loss) or mean_loss > 2 * base
+    rc = RobustConfig(aggregator=aggregator, trim_frac=0.25, krum_f=2)
+    from dopt.engine import FederatedTrainer
+
+    robust_loss = FederatedTrainer(
+        _fed_cfg(_ATTACK, robust=rc)).run(rounds=4).rows[-1]["test_loss"]
+    if aggregator == "krum":
+        ref = _final_test_loss("krum_base", _fed_cfg(robust=rc))
+        assert robust_loss <= 10 * base, (robust_loss, base)
+    else:
+        ref = base
+    assert np.isfinite(robust_loss) and robust_loss <= 2 * ref, (
+        aggregator, robust_loss, ref)
+
+
+def test_clipped_gossip_converges_where_plain_mean_diverges(devices):
+    # The decentralized half of the criterion: 1 liar on an 8-ring.
+    from dopt.engine import GossipTrainer
+
+    atk = dataclasses.replace(_ATTACK, corrupt_max=1)
+    base = GossipTrainer(_gossip_cfg()).run(rounds=4).rows[-1]["avg_test_loss"]
+    plain = GossipTrainer(
+        _gossip_cfg(atk)).run(rounds=4).rows[-1]["avg_test_loss"]
+    assert not np.isfinite(plain) or plain > 2 * base
+    clipped = GossipTrainer(
+        _gossip_cfg(atk, robust=RobustConfig(clip_radius=1.0))
+    ).run(rounds=4).rows[-1]["avg_test_loss"]
+    assert np.isfinite(clipped) and clipped <= 2 * base, (clipped, base)
+
+
+@pytest.mark.slow
+def test_signflip_and_stale_modes_run_and_ledger(devices):
+    from dopt.engine import FederatedTrainer
+
+    for mode in ("signflip", "stale"):
+        fc = FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode=mode)
+        rc = RobustConfig(aggregator="median")
+        h = FederatedTrainer(_fed_cfg(fc, robust=rc)).run(rounds=2)
+        assert any(r["action"] == f"injected_{mode}" for r in h.faults)
+        assert all(np.isfinite(r["test_loss"]) for r in h.rows)
+
+
+@pytest.mark.slow
+def test_scaffold_companion_channel_is_corrupted_too(devices):
+    # A liar lies on every channel it reports: under SCAFFOLD its
+    # control-variate update is corrupted under the same mask, so
+    # c_global differs from the clean run's (the documented
+    # SCAFFOLD-under-Byzantine exposure), while nan-mode lanes stay
+    # screened out of both theta and the companion state.
+    import jax
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode="signflip")
+    clean = FederatedTrainer(_fed_cfg(algorithm="scaffold"))
+    clean.run(rounds=2)
+    lied = FederatedTrainer(_fed_cfg(fc, algorithm="scaffold"))
+    lied.run(rounds=2)
+    diff = sum(float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+               for a, b in zip(jax.tree.leaves(clean.c_global),
+                               jax.tree.leaves(lied.c_global)))
+    assert diff > 0.0
+    fcn = FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode="nan")
+    h = FederatedTrainer(_fed_cfg(fcn, algorithm="scaffold")).run(rounds=2)
+    assert all(np.isfinite(r["test_loss"]) for r in h.rows)
+
+
+@pytest.mark.slow
+def test_compact_full_width_parity_under_corrupt(devices):
+    # NaN liars + crashes: the compact path (survivor lanes + lane
+    # screen) and the full-width path (mask x finite screen) must form
+    # the same aggregate, ledger, and metrics.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=0.4, corrupt_mode="nan", crash=0.3)
+    hc = FederatedTrainer(dataclasses.replace(
+        _fed_cfg(fc, frac=0.5, compact=True), mesh_devices=1)).run(rounds=3)
+    hf = FederatedTrainer(dataclasses.replace(
+        _fed_cfg(fc, frac=0.5, compact=False), mesh_devices=1)).run(rounds=3)
+    assert hc.faults == hf.faults and hc.faults
+    for rc_, rf_ in zip(hc.rows, hf.rows):
+        assert set(rc_) == set(rf_)
+        for k in rc_:
+            np.testing.assert_allclose(rc_[k], rf_[k], rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_blocked_matches_per_round_under_corrupt(devices):
+    # The corrupt masks ride the fused scan as data: per-round and
+    # blocked execution produce identical History AND ledger on both
+    # engines (full-width federated; clipped gossip).
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    fc = FaultConfig(corrupt=0.5, corrupt_mode="signflip")
+    ha = FederatedTrainer(_fed_cfg(fc, frac=0.5)).run(rounds=2, block=1)
+    hb = FederatedTrainer(_fed_cfg(fc, frac=0.5)).run(rounds=2, block=2)
+    assert ha.rows == hb.rows and ha.faults == hb.faults and ha.faults
+    rc = RobustConfig(clip_radius=1.0)
+    ga = GossipTrainer(_gossip_cfg(fc, robust=rc)).run(rounds=2, block=1)
+    gb = GossipTrainer(_gossip_cfg(fc, robust=rc)).run(rounds=2, block=2)
+    assert ga.rows == gb.rows and ga.faults == gb.faults and ga.faults
+
+
+def test_quarantine_lifecycle_federated(devices):
+    # Worker 0 NaNs every round: screened twice -> quarantined (masked
+    # out of the sample) -> readmitted after the backoff -> reoffends.
+    # Global metrics stay finite throughout.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan")
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=2)
+    h = FederatedTrainer(_fed_cfg(fc, robust=rc)).run(rounds=8)
+    acts = [(r["round"], r["worker"], r["action"]) for r in h.faults
+            if r["worker"] == 0]
+    assert (1, 0, "quarantined_until_4") in acts
+    assert (2, 0, "excluded_while_quarantined") in acts
+    assert (4, 0, "readmitted") in acts
+    assert (5, 0, "quarantined_until_8") in acts   # reoffended
+    assert all(np.isfinite(r["test_loss"]) for r in h.rows)
+
+
+@pytest.mark.slow
+def test_quarantine_lifecycle_gossip(devices):
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan")
+    rc = RobustConfig(clip_radius=1.0, quarantine_after=2,
+                      quarantine_rounds=2)
+    h = GossipTrainer(_gossip_cfg(fc, robust=rc)).run(rounds=6)
+    acts = [r["action"] for r in h.faults if r["worker"] == 0]
+    assert "quarantined_until_4" in acts and "readmitted" in acts
+    assert all(np.isfinite(r["avg_test_loss"]) for r in h.rows
+               if "avg_test_loss" in r)
+
+
+@pytest.mark.parametrize("engine", [
+    pytest.param("federated", marks=pytest.mark.slow),
+    pytest.param("gossip", marks=pytest.mark.slow),
+])
+def test_byzantine_resume_bit_exact_with_quarantine(engine, tmp_path,
+                                                    devices):
+    # Satellite: the ledger (corrupt + quarantine rows) and the
+    # quarantine streak state survive save/restore — a killed-and-
+    # resumed adversarial run is bit-identical to a continuous one.
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode="nan",
+                     crash=0.2)
+    if engine == "federated":
+        rc = RobustConfig(aggregator="trimmed_mean", trim_frac=0.25,
+                          quarantine_after=2, quarantine_rounds=2)
+        mk = lambda: FederatedTrainer(_fed_cfg(fc, robust=rc, frac=0.5))
+    else:
+        rc = RobustConfig(clip_radius=1.0, quarantine_after=2,
+                          quarantine_rounds=2)
+        mk = lambda: GossipTrainer(_gossip_cfg(fc, robust=rc))
+    path = os.fspath(tmp_path / engine)
+    hc = mk().run(rounds=6)
+    part = mk()
+    part.run(rounds=3, checkpoint_every=3, checkpoint_path=path)
+    res = mk()
+    res.restore(path)
+    assert res.round == 3
+    hr = res.run(rounds=3)
+    assert hr.rows == hc.rows
+    assert hr.faults == hc.faults
+    assert any(r["kind"] == "quarantine" for r in hc.faults)
+    assert any(r["kind"] == "corrupt" for r in hc.faults)
+
+
+def test_robust_rejections(devices):
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    with pytest.raises(ValueError, match="comm_dtype"):
+        FederatedTrainer(_fed_cfg(
+            robust=RobustConfig(aggregator="median"), comm_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="clip_radius"):
+        GossipTrainer(_gossip_cfg(robust=RobustConfig(aggregator="krum")))
+    with pytest.raises(ValueError, match="stale"):
+        GossipTrainer(_gossip_cfg(FaultConfig(corrupt=0.5,
+                                              corrupt_mode="stale")))
+    with pytest.raises(ValueError, match="mixing algorithm"):
+        GossipTrainer(_gossip_cfg(FaultConfig(corrupt=0.5),
+                                  algorithm="nocons"))
+    with pytest.raises(ValueError, match="never communicates"):
+        GossipTrainer(_gossip_cfg(robust=RobustConfig(clip_radius=1.0),
+                                  algorithm="nocons"))
+    with pytest.raises(ValueError, match="comm_dtype"):
+        GossipTrainer(_gossip_cfg(robust=RobustConfig(clip_radius=1.0),
+                                  comm_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="choco"):
+        GossipTrainer(_gossip_cfg(FaultConfig(corrupt=0.5),
+                                  algorithm="choco"))
+    with pytest.raises(ValueError, match="shift"):
+        GossipTrainer(_gossip_cfg(FaultConfig(corrupt=0.5),
+                                  comm_impl="shift"))
+
+
+@pytest.mark.slow
+def test_cli_byzantine_flags(devices, capsys):
+    from dopt.run import main
+
+    rc = main(["--preset", "baseline1", "--rounds", "2",
+               "--synthetic-scale", "0.01",
+               "--corrupt", "p=1,max=1,mode=scale,scale=50",
+               "--aggregator", "mean",
+               "--set", "robust.clip_radius=1.0"])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "fault ledger" in out.err
+
+
+# ---------------------------------------------------------------------------
+# Fault-ledger round-trip & atomic artifact writes
+# ---------------------------------------------------------------------------
+
+def test_ledger_roundtrip_through_checkpoint(tmp_path, devices):
+    # Ledger rows (including corrupt/quarantine kinds) survive
+    # save/restore verbatim.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan",
+                     crash=0.3)
+    rc = RobustConfig(quarantine_after=1, quarantine_rounds=2)
+    tr = FederatedTrainer(_fed_cfg(fc, robust=rc, frac=0.5))
+    tr.run(rounds=4)
+    path = os.fspath(tmp_path / "ck")
+    tr.save(path)
+    tr2 = FederatedTrainer(_fed_cfg(fc, robust=rc, frac=0.5))
+    tr2.restore(path)
+    assert tr2.history.faults == tr.history.faults
+    kinds = {r["kind"] for r in tr2.history.faults}
+    assert "corrupt" in kinds and "quarantine" in kinds
+    # and the JSON export round-trips
+    out = tmp_path / "ledger.json"
+    tr2.history.faults_to_json(out)
+    assert json.loads(out.read_text()) == tr2.history.faults
+
+
+def test_atomic_writes_survive_midwrite_kill(tmp_path, monkeypatch):
+    # Satellite: History exports (--faults-json, results CSV/JSON) are
+    # temp-file + os.replace.  A kill mid-write (simulated by making the
+    # final replace explode) leaves the previous complete artifact
+    # intact and no truncated JSON behind.
+    from dopt.utils import metrics as m
+
+    h = m.History("t")
+    h.append(round=0, test_acc=0.5)
+    h.log_fault(round=0, worker=1, kind="corrupt", action="screened")
+    jpath, cpath, fpath = (tmp_path / "h.json", tmp_path / "h.csv",
+                           tmp_path / "f.json")
+    h.to_json(jpath), h.to_csv(cpath), h.faults_to_json(fpath)
+    before = {p: p.read_text() for p in (jpath, cpath, fpath)}
+
+    def boom(src, dst):
+        raise OSError("killed mid-write")
+
+    h.append(round=1, test_acc=0.9)
+    monkeypatch.setattr(m.os, "replace", boom)
+    for fn, p in ((h.to_json, jpath), (h.to_csv, cpath),
+                  (h.faults_to_json, fpath)):
+        with pytest.raises(OSError):
+            fn(p)
+    monkeypatch.undo()
+    for p, text in before.items():
+        assert p.read_text() == text          # old artifact untouched
+        json.loads(p.read_text()) if p.suffix == ".json" else None
+    assert not list(tmp_path.glob(".*tmp*"))  # no orphaned temp files
