@@ -137,6 +137,25 @@ class FederatedConfig:
     # on a sharded mesh): per-device partial sums cross ICI/DCN at this
     # dtype (e.g. "bfloat16"); local math stays full precision.
     # Mirrors GossipConfig.comm_dtype.
+    staleness_max: int = 0
+    # Staleness-aware aggregation (0 = off, the hard-drop reference
+    # semantics).  When > 0, a deadline-missed straggler
+    # (``FaultConfig.straggler_policy="drop"``) or a delay-faulted
+    # uplink (``FaultConfig.msg_delay``) is no longer discarded: the
+    # client finishes its full local work, its update is buffered, and
+    # it is admitted into the aggregate of round t+d (d <=
+    # staleness_max; later arrivals are dropped) with weight
+    # ``staleness_decay**d`` — so late work still moves theta, just
+    # with discounted trust.  Admitted updates pass the same non-finite
+    # screen as immediate ones and respect quarantine, composing with
+    # the Byzantine path.  Forces full-width per-round execution;
+    # fedavg/fedprox only (SCAFFOLD/ADMM companion state has no
+    # late-admission semantics).
+    staleness_decay: float = 0.5
+    # Per-round decay of a buffered update's aggregation weight: an
+    # update admitted d rounds late enters the weighted average with
+    # weight decay**d (1.0 = late counts like fresh, small = distrust
+    # stale work).
 
 
 @dataclass(frozen=True)
@@ -224,13 +243,28 @@ class GossipConfig:
     # mesh shape / lane fold (workers-per-device).  Exact-dtype runs
     # (comm_dtype=None) are bit-identical across both paths and any
     # fold — that equality is what the test suite pins.
+    correction: str = "none"
+    # Gossip bias correction under asymmetric message loss: "none" runs
+    # the plain consensus (receiver rows renormalised after drops — the
+    # effective matrix is then no longer doubly stochastic and the fleet
+    # converges to a BIASED weighted average), "push_sum" runs push-sum /
+    # ratio consensus (Kempe et al.; Stochastic Gradient Push, Assran et
+    # al. 2019): every worker carries a scalar mass weight alongside its
+    # parameters, both travel through the SAME column-stochastic
+    # (mass-conserving) effective matrix, and the de-biased estimate is
+    # params/mass — exact-mean consensus under arbitrary drop/delay
+    # traces.  "push_sum" forces the dense comm path and per-round
+    # execution; with no link faults and a doubly-stochastic schedule
+    # the mass stays exactly 1.0 (divide/multiply by 1.0 is exact).
     dropout: float = 0.0
     # DEPRECATED back-compat alias for FaultConfig(crash=p) — warns at
     # trainer construction and produces the identical fault trace
     # (dopt.faults.FaultPlan synthesizes the config); set
-    # ExperimentConfig.faults instead.  Per-round probability each
-    # worker is down: down workers skip consensus AND local training,
-    # the mixing matrix is repaired (dopt.topology.repair_for_dropout)
+    # ExperimentConfig.faults instead.  Scheduled for REMOVAL in release
+    # 0.2.0.  Per-round probability each worker is down: down workers
+    # skip consensus AND local training, the mixing matrix is repaired
+    # (dopt.topology.repair_for_dropout — the degenerate all-links-down
+    # case of the per-edge link-fault model, see FaultConfig.msg_drop)
     # and they rejoin with stale params.
 
 
@@ -298,6 +332,41 @@ class FaultConfig:
     # ``corrupt=1.0, corrupt_max=f`` pins workers 0..f-1 as PERSISTENT
     # adversaries — the classic fixed-f Byzantine setting robust
     # aggregators state their breakdown points against.
+    msg_drop: float = 0.0
+    # Per-round per-DIRECTED-EDGE message-loss probability (the lossy-
+    # link model).  Each direction of each link draws independently, so
+    # loss is asymmetric in general — which is exactly what makes the
+    # row-renormalised effective mixing matrix non-doubly-stochastic
+    # and plain gossip converge to a biased average (the push-sum
+    # correction, ``GossipConfig.correction="push_sum"``, recovers the
+    # true mean).  Gossip: the edge is cut for the round and the
+    # surviving weights repaired as data.  Federated: the probability a
+    # sampled client's UPLINK to the server loses the round's update
+    # (the client keeps its local state; the server sees a failure).
+    msg_delay: float = 0.0
+    # Per-round per-directed-edge message-DELAY probability.  A delayed
+    # gossip edge delivers the sender's state d rounds late (d drawn
+    # uniformly in 1..msg_delay_max), so the receiver mixes against a
+    # stale value — the bounded-staleness asynchronous-gossip model.
+    # The staleness buffer is engine state, carried through blocked
+    # execution and checkpoints.  Federated: a sampled client's uplink
+    # update arrives d rounds late; with
+    # ``FederatedConfig.staleness_max`` > 0 it is buffered and admitted
+    # with decay weighting, otherwise it is lost like a drop.
+    msg_delay_max: int = 2
+    # Maximum delay D in rounds (the staleness bound; buffer depth is
+    # compiled from it, so keep it small).
+    churn: float = 0.0
+    # Per-round per-worker probability an elastic-membership LEAVE event
+    # starts: the worker departs the fleet for ``churn_span`` rounds and
+    # then rejoins (the join event) with its stale state.  While away
+    # the mixing matrix is repaired around it (identity row — same
+    # healing as a crash) / it is excluded from federated sampling, and
+    # its data shard is deterministically reassigned to the next alive
+    # worker (``dopt.data.partition.reassign_shards``) so the departed
+    # data keeps being trained on.  Draws are stateless per round like
+    # every other fault kind.
+    churn_span: int = 4         # rounds a departed worker stays away
     seed: int | None = None     # fault-stream seed; None = experiment seed
 
 

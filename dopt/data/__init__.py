@@ -1,5 +1,6 @@
 from dopt.data.datasets import Dataset, load_dataset
-from dopt.data.partition import holdout_split, iid_split, noniid_split, partition
+from dopt.data.partition import (holdout_split, iid_split, noniid_split,
+                                 partition, reassign_shards)
 from dopt.data.pipeline import (BatchPlan, eval_batches, make_batch_plan,
                                 gather_batches, sharded_eval_batches,
                                 stacked_eval_batches)
@@ -11,6 +12,7 @@ __all__ = [
     "iid_split",
     "noniid_split",
     "partition",
+    "reassign_shards",
     "BatchPlan",
     "eval_batches",
     "make_batch_plan",

@@ -45,7 +45,7 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                make_stacked_local_update,
                                make_stacked_local_update_epochs,
                                prepare_holdout, validate_optimizer)
-from dopt.faults import FaultPlan, corrupt_update
+from dopt.faults import FaultPlan, churn_ledger_rows, corrupt_update
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
 from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
@@ -147,6 +147,52 @@ class FederatedTrainer:
         self._screen_streak = np.zeros(w, np.int64)
         self._quarantine_until = np.zeros(w, np.int64)
 
+        # Staleness-aware aggregation (FederatedConfig.staleness_max):
+        # instead of hard-dropping a deadline-missed straggler
+        # (straggler_policy='drop') or a delay-faulted uplink
+        # (FaultConfig.msg_delay), the client's finished update is
+        # CAPTURED into a one-slot-per-worker device buffer and admitted
+        # into the aggregate of round t+d with weight staleness_decay^d.
+        # Admission passes the same non-finite screen as fresh updates
+        # and respects quarantine, so it composes with the Byzantine
+        # path.  Host bookkeeping (admit round / weight / origin) is
+        # checkpointed, so killed-and-resumed runs replay admissions
+        # bit-exactly.  Forces full-width per-round execution.
+        if f.staleness_max < 0:
+            raise ValueError("FederatedConfig.staleness_max must be >= 0")
+        if not 0.0 < f.staleness_decay <= 1.0:
+            raise ValueError(
+                f"FederatedConfig.staleness_decay={f.staleness_decay} "
+                "must be in (0, 1]")
+        self._staleness_max = f.staleness_max
+        self._staleness_decay = f.staleness_decay
+        produces_late = (self.faults.active and cfg.faults is not None
+                         and ((cfg.faults.straggle > 0
+                               and cfg.faults.straggler_policy == "drop")
+                              or cfg.faults.msg_delay > 0))
+        self._has_stale = f.staleness_max > 0 and produces_late
+        if f.staleness_max > 0:
+            if f.algorithm not in ("fedavg", "fedprox"):
+                raise ValueError(
+                    "staleness-aware aggregation needs a stateless-"
+                    "client algorithm (fedavg|fedprox): SCAFFOLD/ADMM "
+                    "companion state has no late-admission semantics")
+            if aggregator != "mean":
+                raise ValueError(
+                    "staleness-aware aggregation is a weighted mean; "
+                    f"it does not compose with aggregator="
+                    f"{aggregator!r} (selection/trimming have no "
+                    "decayed-weight form here) — drop one of the two")
+            if f.comm_dtype:
+                raise ValueError(
+                    "comm_dtype wire compression only applies to the "
+                    "masked-mean reduce; the staleness-weighted "
+                    "aggregate runs its own full-precision sum — drop "
+                    "one of the two")
+        self._stale_admit_round = np.zeros(w, np.int64)
+        self._stale_weight = np.zeros(w, np.float64)
+        self._stale_origin = np.zeros(w, np.int64)
+
         self.dataset = load_dataset(
             cfg.data.dataset, data_dir=cfg.data.data_dir,
             train_size=cfg.data.synthetic_train_size,
@@ -212,6 +258,11 @@ class FederatedTrainer:
         self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
             jax.tree.map(np.zeros_like, stacked), self.mesh)
+        # Staleness buffer: one pending (late) update slot per worker.
+        self._stale_p = (
+            shard_worker_tree(jax.tree.map(np.zeros_like, stacked),
+                              self.mesh)
+            if self._has_stale else None)
         # Worker-stacked companion state: ADMM duals (clients.py:120-123)
         # or SCAFFOLD client control variates c_i; both live sharded over
         # the worker axis.  SCAFFOLD additionally keeps the replicated
@@ -414,7 +465,11 @@ class FederatedTrainer:
                 c_global, sub_new, sub_old,
             )
 
-        def pack_host_metrics(local_loss, evalm, trainm, em, screened):
+        has_stale = self._has_stale
+        st_clip = clip_radius
+
+        def pack_host_metrics(local_loss, evalm, trainm, em, screened,
+                              stale_scr=None):
             """Everything the host reads per round, as ONE flat f32
             vector — every device→host fetch pays a fixed ~100 ms tunnel
             round-trip on this hardware, so the round's history metrics
@@ -423,12 +478,15 @@ class FederatedTrainer:
             block under the holdout) travel in a single transfer.
             Layout (mirrored by ``_unpack_host_metrics``): [local_loss,
             test_acc, test_loss_sum, mean(train_loss), mean(train_acc)]
-            + [lanes] screened flags + 4×[lanes·E] em blocks."""
+            + [lanes] screened flags + (staleness runs only) [lanes]
+            screened-on-admission flags + 4×[lanes·E] em blocks."""
             parts = [local_loss.reshape(1),
                      evalm["acc"][None], evalm["loss_sum"][None],
                      jnp.mean(trainm["loss_mean"])[None],
                      jnp.mean(trainm["acc"])[None],
                      screened.ravel()]
+            if has_stale:
+                parts.append(stale_scr.ravel())
             if use_holdout:
                 parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
                           em["val_acc"].ravel(), em["val_loss_sum"].ravel()]
@@ -436,7 +494,7 @@ class FederatedTrainer:
 
         def finish(new_theta, new_p, new_m, new_duals, new_c, local_loss,
                    em, screened, train_x, train_y, ex, ey, ew, tidx,
-                   tweight):
+                   tweight, stale_scr=None):
             """Shared round tail: global test eval + all-client train eval
             (``avg_trainig_calculator``) — identical for both execution
             paths so the history schema can never diverge between them.
@@ -451,13 +509,18 @@ class FederatedTrainer:
                           "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
             return (new_theta, new_p, new_m, new_duals, new_c,
                     pack_host_metrics(jnp.asarray(local_loss), evalm,
-                                      trainm, em, screened))
+                                      trainm, em, screened, stale_scr))
 
         def round_fn(theta, params, mom, duals, c_global, mask, limits, idx,
                      bweight, train_x, train_y, ex, ey, ew, tidx, tweight,
-                     vidx, vw, cmask=None):
+                     vidx, vw, cmask=None, load_mask=None, stale_p=None,
+                     admit_w=None, capture=None):
             theta_b = broadcast_to_workers(theta, w)
-            start = _where_mask(mask, theta_b, params)
+            # Staleness runs load theta into every lane that TRAINS this
+            # round (the sampled aggregators AND the captured late
+            # senders); only `mask` lanes enter the immediate aggregate.
+            start = _where_mask(load_mask if has_stale else mask,
+                                theta_b, params)
             p_t, m_t, losses, accs, sub_new, em = algo_step(
                 theta, start, mom, duals, c_global, idx, bweight, limits,
                 train_x, train_y, vidx, vw)
@@ -499,15 +562,56 @@ class FederatedTrainer:
                      else _where_mask(agg_mask, m_t, mom))
             agg_in = (clip_to_ball(new_p, theta, clip_radius)
                       if clip_radius > 0 else new_p)
-            if agg_robust is None:
-                new_theta = masked_average(agg_in, agg_mask, mesh=agg_mesh,
-                                           comm_dtype=agg_comm)
+            if has_stale:
+                # Staleness-weighted aggregation: the round's fresh
+                # survivors at weight 1 plus the admitted late updates
+                # at their decay weights, one normalised weighted sum.
+                # Admitted updates pass the non-finite screen (a lane
+                # that went NaN while buffered enters at weight 0) and
+                # the same clip-to-ball as fresh ones.
+                fin_s = finite_lane_mask(stale_p)
+                aw = admit_w * fin_s
+                # Zero the non-finite buffer lanes BEFORE the weighted
+                # sum: a 0-weighted NaN still poisons the contraction
+                # (0·NaN = NaN) — same guard the gossip robust path
+                # applies to non-finite sends.
+                stale_z = _where_mask(fin_s, stale_p,
+                                      jax.tree.map(jnp.zeros_like, stale_p))
+                agg_stale = (clip_to_ball(stale_z, theta, st_clip)
+                             if st_clip > 0 else stale_z)
+                tot_w = agg_mask.sum() + aw.sum()
+                # Guard only the zero-weight round (theta passes through
+                # via alive_any below): clamping to 1.0 would SHRINK
+                # theta on a round whose total admitted weight is < 1
+                # (e.g. a lone decay-weighted admission).
+                denom = jnp.where(tot_w > 0, tot_w, 1.0)
+
+                def wleaf(x, s):
+                    mm = agg_mask.reshape(
+                        (-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+                    ss = aw.reshape(
+                        (-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+                    return (((x * mm).sum(axis=0) + (s * ss).sum(axis=0))
+                            / denom.astype(x.dtype))
+
+                new_theta = jax.tree.map(wleaf, agg_in, agg_stale)
+                alive_any = tot_w > 0
+                # Captured lanes' finished updates land in the buffer;
+                # everyone else's slot is carried unchanged.
+                new_stale = _where_mask(capture, p_t, stale_p)
+                stale_scr = (admit_w > 0).astype(jnp.float32) * (1.0 - fin_s)
             else:
-                new_theta = agg_robust(agg_in, agg_mask)
+                if agg_robust is None:
+                    new_theta = masked_average(agg_in, agg_mask,
+                                               mesh=agg_mesh,
+                                               comm_dtype=agg_comm)
+                else:
+                    new_theta = agg_robust(agg_in, agg_mask)
+                alive_any = agg_mask.sum() > 0
+                new_stale, stale_scr = None, None
             # A round with zero surviving (unscreened) updates leaves
             # the global model unchanged (the aggregate over zero
             # survivors would otherwise zero theta).
-            alive_any = agg_mask.sum() > 0
             new_theta = jax.tree.map(
                 lambda a, th: jnp.where(alive_any, a, th), new_theta, theta)
             lane_loss = losses.mean(axis=1)
@@ -520,9 +624,12 @@ class FederatedTrainer:
             # Full-width packs ALL W lanes' em rows (gathering the
             # sampled subset would be a dynamic shape); the host slices
             # by the round's sample before appending client rows.
-            return finish(new_theta, new_p, new_m, new_duals, new_c,
-                          local_loss, em, screened, train_x, train_y, ex,
-                          ey, ew, tidx, tweight)
+            out = finish(new_theta, new_p, new_m, new_duals, new_c,
+                         local_loss, em, screened, train_x, train_y, ex,
+                         ey, ew, tidx, tweight, stale_scr)
+            if has_stale:
+                return (*out[:5], new_stale, out[5])
+            return out
 
         # Per-worker train-split eval: every input has a worker axis.
         # Batches come from the FLAT resident train arrays (finish()
@@ -699,24 +806,33 @@ class FederatedTrainer:
 
     def _round_participation(
             self, t: int, frac: float
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list]:
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list,
+               np.ndarray, np.ndarray]:
         """Sample round t's clients and apply its faults: returns
         (survivor indices, [W] straggler work limits, [W] corrupt mask,
-        the round's host-side fault-ledger rows).
+        the round's host-side fault-ledger rows, [W] capture mask,
+        [W] admission weights).
 
         Fault-free this is exactly ``_sample_indices`` (same RNG call,
         same stream — enabling the fault machinery never perturbs the
         sampling sequence).  With faults on, the FedAvg-paper server
         deadline runs on the host: over-select ceil(m·(1+over_select))
-        clients, drop the quarantined / crashed / partition-unreachable
-        / deadline-dropped ones, keep the first m survivors and release
-        the surplus.  Ledger rows are RETURNED rather than appended so
-        both execution paths (per-round and fused-block) can interleave
-        them with the device-side screened rows in the identical order —
-        draws are stateless per round (dopt.faults.FaultPlan), so
-        per-round, blocked, and killed-and-resumed execution log the
-        identical trace."""
+        clients, drop the quarantined / away / crashed /
+        partition-unreachable / uplink-faulted / deadline-dropped ones,
+        keep the first m survivors and release the surplus.  Under
+        staleness-aware aggregation, deadline-missed stragglers and
+        delayed uplinks are CAPTURED (capture mask) instead of dropped
+        and their buffered updates ADMITTED d rounds later (admission
+        weights carry staleness_decay^d).  Ledger rows are RETURNED
+        rather than appended so both execution paths (per-round and
+        fused-block) can interleave them with the device-side screened
+        rows in the identical order — draws are stateless per round
+        (dopt.faults.FaultPlan), so per-round, blocked, and
+        killed-and-resumed execution log the identical trace."""
         rows: list[dict] = []
+        w = self.num_workers
+        capture = np.zeros(w, np.float32)
+        admit_w = np.zeros(w, np.float32)
         if self._quarantine_on:
             expired = ((self._quarantine_until != 0)
                        & (t >= self._quarantine_until))
@@ -725,34 +841,79 @@ class FederatedTrainer:
                              "kind": "quarantine", "action": "readmitted"})
                 self._quarantine_until[i] = 0
                 self._screen_streak[i] = 0
-        m = max(int(frac * self.num_workers), 1)
+        if self._has_stale:
+            # Admissions due this round: buffered late updates enter the
+            # aggregate at their decay weight — unless their sender was
+            # quarantined meanwhile (composition with the Byzantine
+            # detection layer: a benched worker's pending work is
+            # distrusted wholesale).
+            due = (self._stale_admit_round == t) & (self._stale_weight > 0)
+            for i in np.nonzero(due)[0]:
+                if (self._quarantine_on
+                        and t < self._quarantine_until[i]):
+                    rows.append({"round": int(t), "worker": int(i),
+                                 "kind": "staleness",
+                                 "action": "dropped_quarantined"})
+                else:
+                    admit_w[i] = np.float32(self._stale_weight[i])
+                    d = int(t - self._stale_origin[i])
+                    rows.append({"round": int(t), "worker": int(i),
+                                 "kind": "staleness",
+                                 "action": f"admitted_after_{d}_rounds"})
+                self._stale_admit_round[i] = 0
+                self._stale_weight[i] = 0.0
+        away = self.faults.away_for_round(t)
+        if self.faults.has_churn:
+            rows.extend(churn_ledger_rows(self.faults, t, away))
+        m = max(int(frac * w), 1)
         c = self.faults.cfg
         n_draw = m
         if self.faults.active and c.over_select > 0.0:
-            n_draw = min(int(np.ceil(m * (1.0 + c.over_select))),
-                         self.num_workers)
+            n_draw = min(int(np.ceil(m * (1.0 + c.over_select))), w)
         # Keep the RNG's DRAW order for the survivor cut below: the
         # over-selection surplus must be released uniformly (sorting
         # first would systematically release the highest worker ids,
         # biasing participation toward low ids); the final survivor
         # set is sorted on return.
         chosen = self._sample_rng.choice(
-            self.num_workers, n_draw, replace=False).astype(np.int32)
+            w, n_draw, replace=False).astype(np.int32)
         rf = self.faults.for_round(t)
         limits = FaultPlan.limits_for(rf, self._straggle_units)
-        cmask = np.zeros(self.num_workers, np.float32)
+        cmask = np.zeros(w, np.float32)
+        up_drop, up_delay = self.faults.uplink_for_round(t)
         quarantined_now = (self._quarantine_on
                            and bool((self._quarantine_until > t).any()))
-        if not rf.any_fault and n_draw == m and not quarantined_now:
-            return np.sort(chosen), limits, cmask, rows
+        if (not rf.any_fault and n_draw == m and not quarantined_now
+                and not away.any() and not up_drop.any()
+                and not up_delay.any() and not admit_w.any()):
+            return np.sort(chosen), limits, cmask, rows, capture, admit_w
         drop_policy = c is not None and c.straggler_policy == "drop"
+        late_d = (self.faults.straggler_lateness(t, self._staleness_max)
+                  if self._has_stale else None)
         survivors: list[int] = []
+        captured: list[int] = []
+
+        def _capture(i: int, d: int) -> None:
+            d = min(int(d), self._staleness_max)
+            if self._stale_admit_round[i] > t:
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "staleness",
+                             "action": "pending_overwritten"})
+            capture[i] = 1.0
+            captured.append(i)
+            self._stale_admit_round[i] = t + d
+            self._stale_weight[i] = float(self._staleness_decay) ** d
+            self._stale_origin[i] = t
+
         for i in chosen:
             i = int(i)
             if quarantined_now and t < self._quarantine_until[i]:
                 rows.append({"round": int(t), "worker": i,
                              "kind": "quarantine",
                              "action": "excluded_while_quarantined"})
+            elif away[i]:
+                rows.append({"round": int(t), "worker": i, "kind": "churn",
+                             "action": "excluded_while_away"})
             elif rf.crashed[i]:
                 rows.append({"round": int(t), "worker": i, "kind": "crash",
                              "action": "dropped_from_round"})
@@ -762,9 +923,41 @@ class FederatedTrainer:
                     "round": int(t), "worker": i, "kind": "partition",
                     "action": f"unreachable_in_group_{int(rf.partition[i])}"})
             elif rf.straggler[i] and drop_policy:
+                if self._has_stale:
+                    # Staleness-aware: the straggler finishes its FULL
+                    # local work and its update arrives d rounds late
+                    # (under policy='drop' the device compiles
+                    # with_limit=False, so the limits vector is never
+                    # applied — no truncation to undo here).
+                    d = min(int(late_d[i]), self._staleness_max)
+                    rows.append({
+                        "round": int(t), "worker": i, "kind": "straggler",
+                        "action": f"deadline_buffered_arriving_{t + d}"})
+                    _capture(i, d)
+                else:
+                    # Audit-complete hard drop: record the step budget
+                    # the straggler actually executed before the server
+                    # deadline (the with_limit value), not just the
+                    # deadline action.
+                    rows.append({
+                        "round": int(t), "worker": i, "kind": "straggler",
+                        "action": (f"deadline_dropped_after_"
+                                   f"{int(limits[i])}_of_"
+                                   f"{self._straggle_units}")})
+            elif up_drop[i]:
                 rows.append({"round": int(t), "worker": i,
-                             "kind": "straggler",
-                             "action": "deadline_dropped"})
+                             "kind": "msg_drop", "action": "uplink_dropped"})
+            elif up_delay[i] > 0:
+                d = int(up_delay[i])
+                if self._has_stale and d <= self._staleness_max:
+                    rows.append({"round": int(t), "worker": i,
+                                 "kind": "msg_delay",
+                                 "action": f"uplink_buffered_delay_{d}"})
+                    _capture(i, d)
+                else:
+                    rows.append({"round": int(t), "worker": i,
+                                 "kind": "msg_delay",
+                                 "action": f"uplink_dropped_stale_{d}"})
             else:
                 survivors.append(i)
         for i in survivors[m:]:
@@ -781,13 +974,15 @@ class FederatedTrainer:
                                    f"_of_{self._straggle_units}")})
         if self._has_corrupt and rf.corrupt is not None:
             mode = self.cfg.faults.corrupt_mode
-            for i in survivors:
+            # A liar lies on the late channel too: captured updates are
+            # corrupted under the same mask as fresh ones.
+            for i in sorted(set(survivors.tolist()) | set(captured)):
                 if rf.corrupt[i]:
                     cmask[i] = 1.0
                     rows.append({"round": int(t), "worker": int(i),
                                  "kind": "corrupt",
                                  "action": f"injected_{mode}"})
-        return survivors, limits, cmask, rows
+        return survivors, limits, cmask, rows, capture, admit_w
 
     def _apply_screen_feedback(self, t: int, workers, flags,
                                rows: list) -> None:
@@ -816,6 +1011,16 @@ class FederatedTrainer:
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
+        if self._has_stale:
+            # The staleness path needs full-width lanes: captured late
+            # senders train outside the aggregating sample, and the
+            # one-slot-per-worker buffer is a [W, ...] scatter target.
+            if f.compact:
+                raise ValueError(
+                    "FederatedConfig.compact=True is incompatible with "
+                    "staleness-aware aggregation (captured lanes train "
+                    "outside the sampled set) — drop one of the two")
+            return False
         if f.comm_dtype:
             # The compact path's aggregation is a local mean over m
             # lanes — no cross-worker collective to compress — so the
@@ -875,7 +1080,7 @@ class FederatedTrainer:
                 frows = [p[3] for p in parts]
                 plans = [
                     make_batch_plan(
-                        self._train_matrix, batch_size=f.local_bs,
+                        self._plan_matrix_for_round(t), batch_size=f.local_bs,
                         local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
                         impl=cfg.data.plan_impl,
                         workers=sel if compact else None,
@@ -926,7 +1131,7 @@ class FederatedTrainer:
             packed = np.asarray(packed)  # ONE device→host fetch per block
             lanes = len(sels[0]) if compact else self.num_workers
             for j, t in enumerate(ts):
-                ll, acc, loss_sum, t_loss, t_acc, scr, em = \
+                ll, acc, loss_sum, t_loss, t_acc, scr, _, em = \
                     self._unpack_host_metrics(packed[j], lanes)
                 flags = scr if compact else scr[sels[j]]
                 self._apply_screen_feedback(t, sels[j], flags, frows[j])
@@ -973,13 +1178,15 @@ class FederatedTrainer:
             raise ValueError("checkpoint_every requires checkpoint_path")
         if (block > 1
                 and not (self.faults.active and self._use_compact(frac))
-                and not self._quarantine_on):
+                and not self._quarantine_on
+                and not self._has_stale):
             # Compact + faults stays per-round: survivor counts vary
             # round to round and the compact block stacks fixed-width
             # lane sets.  Quarantine stays per-round too: the next
             # round's participation depends on THIS round's device-side
             # screen flags, which a fused block only surfaces at its
-            # end.
+            # end.  Staleness-aware aggregation stays per-round: the
+            # host schedules buffer captures/admissions round by round.
             return self._run_blocked(frac, rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
@@ -988,7 +1195,8 @@ class FederatedTrainer:
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                sel, limits, cmask, frows = self._round_participation(t, frac)
+                (sel, limits, cmask, frows, cap,
+                 admit) = self._round_participation(t, frac)
                 # The compact path needs >= 1 survivor lane; a round
                 # whose every sampled client failed degrades to one
                 # full-width step with an all-zero mask (theta and all
@@ -998,7 +1206,8 @@ class FederatedTrainer:
                 # host cost O(m), and the RNG is keyed by true worker id
                 # so the plans are bit-identical to the full plan's rows.
                 plan = make_batch_plan(
-                    self._train_matrix, batch_size=f.local_bs, local_ep=f.local_ep,
+                    self._plan_matrix_for_round(t), batch_size=f.local_bs,
+                    local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                     workers=sel if use_c else None,
                 )
@@ -1018,8 +1227,13 @@ class FederatedTrainer:
             gate = jnp.asarray(sel) if use_c else jnp.asarray(mask)
             step_kw = ({"cmask": jnp.asarray(cmask[sel] if use_c else cmask)}
                        if self._has_corrupt else {})
-            (self.theta, self.params, self.momentum, new_duals, new_c,
-             packed) = self.timers.measure(
+            if self._has_stale:
+                step_kw.update(
+                    load_mask=jnp.asarray(np.clip(mask + cap, 0.0, 1.0)),
+                    stale_p=self._stale_p,
+                    admit_w=jnp.asarray(admit),
+                    capture=jnp.asarray(cap))
+            out = self.timers.measure(
                 "round_step", step_fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
                 gate, lim_dev, idx, bweight,
@@ -1027,16 +1241,26 @@ class FederatedTrainer:
                 self._train_eval_idx, self._train_eval_w, *self._val,
                 **step_kw,
             )
+            (self.theta, self.params, self.momentum, new_duals,
+             new_c) = out[:5]
+            if self._has_stale:
+                self._stale_p = out[5]
+            packed = out[-1]
             if self.duals is not None:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
             lanes = len(sel) if use_c else self.num_workers
-            ll, acc, loss_sum, t_loss, t_acc, scr, em = \
+            ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em = \
                 self._unpack_host_metrics(
                     np.asarray(packed), lanes)  # ONE device→host fetch/round
             flags = scr if use_c else scr[sel]
             self._apply_screen_feedback(t, sel, flags, frows)
+            if self._has_stale and sscr is not None:
+                for i in np.nonzero(sscr > 0.5)[0]:
+                    frows.append({"round": int(t), "worker": int(i),
+                                  "kind": "staleness",
+                                  "action": "screened_nonfinite_on_admission"})
             self.history.faults.extend(frows)
             self.history.append(
                 round=t,
@@ -1059,19 +1283,28 @@ class FederatedTrainer:
     def _unpack_host_metrics(self, vec: np.ndarray, lanes: int):
         """Inverse of the round step's ``pack_host_metrics``: one fetched
         f32 vector → (local_loss, test_acc, test_loss_sum, train_loss,
-        train_acc, [lanes] screened flags, em dict of [lanes, E] arrays
-        or {})."""
+        train_acc, [lanes] screened flags, [lanes]
+        screened-on-admission flags (staleness runs; else None), em dict
+        of [lanes, E] arrays or {})."""
         ll, acc, loss_sum, t_loss, t_acc = (float(v) for v in vec[:5])
         scr = vec[5:5 + lanes]
+        off = 5 + lanes
+        sscr = None
+        if self._has_stale:
+            sscr = vec[off:off + lanes]
+            off += lanes
         em: dict[str, np.ndarray] = {}
         if self._holdout:
             e = self.cfg.federated.local_ep
             n = lanes * e
-            body = vec[5 + lanes:]
+            body = vec[off:]
             for i, k in enumerate(("train_loss", "train_acc", "val_acc",
                                    "val_loss")):
                 em[k] = body[i * n:(i + 1) * n].reshape(lanes, e)
-        return ll, acc, loss_sum, t_loss, t_acc, scr, em
+        return ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em
+
+    def _plan_matrix_for_round(self, t: int) -> np.ndarray:
+        return self.faults.plan_matrix_for(t, self._train_matrix)
 
     def _append_client_rows(self, t: int, em: dict, workers) -> None:
         """Per-epoch per-client history rows (P1 Client.history schema,
@@ -1104,6 +1337,11 @@ class FederatedTrainer:
             arrays["duals"] = self.duals
         if self.c_global is not None:
             arrays["c_global"] = self.c_global
+        if self._has_stale:
+            # The staleness buffer + its host schedule are carried
+            # state: without them a resumed run would mis-admit (or
+            # lose) the in-flight late updates.
+            arrays["stale_p"] = self._stale_p
         save_checkpoint(
             path, arrays=arrays,
             meta={"round": self.round, "name": self.cfg.name,
@@ -1113,6 +1351,9 @@ class FederatedTrainer:
                   "fault_ledger": self.history.faults,
                   "screen_streak": self._screen_streak.tolist(),
                   "quarantine_until": self._quarantine_until.tolist(),
+                  "stale_admit_round": self._stale_admit_round.tolist(),
+                  "stale_weight": self._stale_weight.tolist(),
+                  "stale_origin": self._stale_origin.tolist(),
                   "sample_rng_state": self._sample_rng.bit_generator.state},
         )
 
@@ -1152,6 +1393,18 @@ class FederatedTrainer:
             meta.get("screen_streak", [0] * w), np.int64)
         self._quarantine_until = np.asarray(
             meta.get("quarantine_until", [0] * w), np.int64)
+        if self._has_stale:
+            if "stale_p" not in arrays:
+                raise ValueError(
+                    "staleness-aware trainer requires its late-update "
+                    "buffer ('stale_p') in the checkpoint")
+            self._stale_p = shard_worker_tree(arrays["stale_p"], self.mesh)
+            self._stale_admit_round = np.asarray(
+                meta.get("stale_admit_round", [0] * w), np.int64)
+            self._stale_weight = np.asarray(
+                meta.get("stale_weight", [0.0] * w), np.float64)
+            self._stale_origin = np.asarray(
+                meta.get("stale_origin", [0] * w), np.int64)
         if meta.get("sample_rng_state"):
             self._sample_rng.bit_generator.state = meta["sample_rng_state"]
 

@@ -45,7 +45,7 @@ from dopt.parallel.collectives import mix_dense, mix_shifts, where_mask
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
                                 worker_sharding)
-from dopt.faults import FaultPlan, corrupt_update
+from dopt.faults import FaultPlan, churn_ledger_rows, corrupt_update
 from dopt.robust import (byzantine_mix, clipped_gossip_mix,
                          finite_lane_mask, validate_robust_config)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
@@ -309,6 +309,50 @@ class GossipTrainer:
                 "algorithm to act on (dsgd|fedlcon|gossip); "
                 f"{cfg.gossip.algorithm!r} never communicates")
 
+        # Lossy-link network model (dopt.faults msg_drop / msg_delay) and
+        # the push-sum bias correction (GossipConfig.correction).  Both
+        # route consensus through the link-matrix path: the round's
+        # effective mixing becomes a [D+1, n, n] per-staleness stack
+        # (dopt.topology.split_by_delay) contracted against the current
+        # sends plus up-to-D-rounds-stale buffered state carried as
+        # engine state.  correction="push_sum" additionally carries a
+        # scalar mass per worker through the SAME (column-stochastic,
+        # mass-conserving) matrices and de-biases as params/mass —
+        # ratio consensus / Stochastic Gradient Push.  Everything is
+        # gated on _link_mode so clean runs compile the exact
+        # pre-change program.
+        if g.correction not in ("none", "push_sum"):
+            raise ValueError(f"unknown gossip correction {g.correction!r}; "
+                             "one of none|push_sum")
+        self._push_sum = g.correction == "push_sum"
+        self._has_link = self.faults.has_link
+        self._link_mode = self._has_link or self._push_sum
+        self._delay_max = self.faults.delay_max
+        if self._link_mode:
+            if g.algorithm not in ("dsgd", "gossip"):
+                raise ValueError(
+                    "link faults (msg_drop/msg_delay) and "
+                    "correction='push_sum' need a single-sweep mixing "
+                    "algorithm (dsgd|gossip), not "
+                    f"{g.algorithm!r}")
+            if g.comm_dtype:
+                raise ValueError(
+                    "comm_dtype wire compression only applies to the "
+                    "plain consensus collectives; the link-fault / "
+                    "push-sum path runs its own per-staleness "
+                    "contractions — drop one of the two")
+            if clip_tau > 0 or self._quarantine_on:
+                raise ValueError(
+                    "clipped gossip / quarantine do not compose with "
+                    "the lossy-link consensus path yet — run the robust "
+                    "layer and link faults in separate experiments")
+            if has_corrupt and cfg.faults.corrupt_mode in ("nan", "inf"):
+                raise ValueError(
+                    "corrupt_mode='nan'/'inf' under link faults would "
+                    "need byzantine_mix's poison routing, which the "
+                    "per-staleness link path does not implement; use "
+                    "the finite lies (scale|signflip)")
+
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
         l2 = cfg.optim.weight_decay
@@ -425,8 +469,15 @@ class GossipTrainer:
                 "comm_impl='shift' is incompatible with the robust layer: "
                 "clipped mixing / corrupt sends need the dense pairwise "
                 "path (the 'auto' default picks it)")
+        if g.comm_impl == "shift" and self._link_mode:
+            raise ValueError(
+                "comm_impl='shift' is incompatible with link faults / "
+                "push-sum: drop-repaired matrices leave the compiled "
+                "shift set and the per-staleness stack needs the dense "
+                "path (the 'auto' default picks it)")
         self._shift_ids: tuple[int, ...] | None = None
         if (g.comm_impl != "dense" and not robust_active
+                and not self._link_mode
                 and self.mixing is not None and (do_mix or is_choco)):
             flat_1d = len(mesh.axis_names) == 1
             extra = (0,) if self.faults.affects_matrix else ()
@@ -723,6 +774,138 @@ class GossipTrainer:
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
 
+        # ---- lossy-link / push-sum consensus path ---------------------
+        # Engine state: `_mass` is the push-sum mass vector (ones —
+        # exactly 1.0 forever under a doubly-stochastic fault-free
+        # schedule); `_link_buf` is the bounded staleness buffer, [D, W,
+        # ...] per leaf — under correction='none' it holds the fleet's
+        # last D broadcast snapshots (a delayed edge mixes against one),
+        # under push-sum the IN-FLIGHT packets (value mass en route,
+        # slot d arrives in d+1 rounds) with `_link_buf_mass` the
+        # matching scalar mass — so node mass + in-flight mass is
+        # conserved at exactly n every round, the invariant
+        # tests/test_network.py pins.  All of it is checkpointed;
+        # link-mode runs execute per-round (the stack of per-staleness
+        # matrices is host data per round).
+        self._mass: object = {}
+        self._link_buf: object = {}
+        self._link_buf_mass: object = {}
+        if self._link_mode:
+            D = self._delay_max
+            buf_sharding = jax.sharding.NamedSharding(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, worker_axes(self.mesh)))
+            if self._push_sum:
+                self._mass = jax.device_put(np.ones(w, np.float32))
+                if D > 0:
+                    self._link_buf = jax.device_put(
+                        jax.tree.map(
+                            lambda x: np.zeros((D,) + x.shape, x.dtype),
+                            stacked), buf_sharding)
+                    self._link_buf_mass = jax.device_put(
+                        np.zeros((D, w), np.float32))
+            elif D > 0:
+                # History snapshots: every slot starts at the common
+                # init (what each worker would have broadcast before
+                # round 0), so early-round staleness is well defined
+                # and a resumed run reloads the exact carried history.
+                self._link_buf = jax.device_put(
+                    jax.tree.map(
+                        lambda x: np.broadcast_to(
+                            x[None], (D,) + x.shape).copy(), stacked),
+                    buf_sharding)
+
+            push_sum, D_link = self._push_sum, self._delay_max
+            num_w = w
+
+            def _tree_add(a, b):
+                return jax.tree.map(jnp.add, a, b)
+
+            def link_round_fn(params, mom, mass, buf, buf_mass, mats,
+                              alive, limits, t, idx, bweight, train_x,
+                              train_y, ex, ey, ew, vidx, vw, do_eval,
+                              cmask=None):
+                """One round through the lossy-link consensus: ``mats``
+                is the [D+1, n, n] per-staleness stack for the round
+                (slot 0 immediate; row-stochastic overall for
+                correction='none', column-stochastic overall for
+                push-sum).  Under push-sum ``params`` carries the
+                NUMERATOR x; the de-biased estimate z = x/mass is what
+                trains and evaluates, and z·mass is carried back."""
+                x_send = (corrupt_update(params, cmask, corrupt_mode,
+                                         corrupt_scale)
+                          if has_corrupt else params)
+                new_buf, new_buf_mass = buf, buf_mass
+                if push_sum:
+                    now_x = mix_dense(x_send, mats[0], mesh)
+                    now_m = jnp.tensordot(mats[0], mass, axes=[[1], [0]])
+                    if D_link > 0:
+                        now_x = _tree_add(
+                            now_x, jax.tree.map(lambda b: b[0], buf))
+                        now_m = now_m + buf_mass[0]
+                        arr = [mix_dense(x_send, mats[d], mesh)
+                               for d in range(1, D_link + 1)]
+                        arr_m = jnp.stack(
+                            [jnp.tensordot(mats[d], mass, axes=[[1], [0]])
+                             for d in range(1, D_link + 1)])
+
+                        def slot_upd(b, *sends):
+                            shifted = jnp.concatenate(
+                                [b[1:], jnp.zeros_like(b[:1])], axis=0)
+                            return shifted + jnp.stack(sends, axis=0)
+
+                        new_buf = jax.tree.map(slot_upd, buf, *arr)
+                        new_buf_mass = jnp.concatenate(
+                            [buf_mass[1:], jnp.zeros_like(buf_mass[:1])],
+                            axis=0) + arr_m
+                    safe_m = jnp.maximum(now_m, 1e-12)
+
+                    def debias(xl):
+                        mm = safe_m.reshape(
+                            (-1,) + (1,) * (xl.ndim - 1))
+                        return (xl.astype(jnp.float32)
+                                / mm).astype(xl.dtype)
+
+                    mixed = jax.tree.map(debias, now_x)
+                    mass_out = now_m
+                else:
+                    mixed = mix_dense(x_send, mats[0], mesh)
+                    if D_link > 0:
+                        for d in range(1, D_link + 1):
+                            snap = jax.tree.map(lambda b, _d=d: b[_d - 1],
+                                                buf)
+                            mixed = _tree_add(
+                                mixed, mix_dense(snap, mats[d], mesh))
+                        new_buf = jax.tree.map(
+                            lambda b, s: jnp.concatenate(
+                                [s[None], b[:-1]], axis=0),
+                            buf, x_send)
+                    mass_out = mass
+                screened = jnp.zeros(num_w, jnp.float32)
+                evalm = jax.lax.cond(
+                    do_eval, lambda: evaluator(mixed, ex, ey, ew),
+                    zeros_eval)
+                p_t, m_t, losses, accs, em = local_phase(
+                    mixed, mom, idx, bweight, train_x, train_y, vidx, vw,
+                    limits)
+                if has_faults:
+                    p_t = where_mask(alive, p_t, mixed)
+                    m_t = where_mask(alive, m_t, mom)
+                tl, ta = train_metrics(losses, accs, alive)
+                if push_sum:
+                    def rebias(zl):
+                        mm = mass_out.reshape(
+                            (-1,) + (1,) * (zl.ndim - 1))
+                        return (zl.astype(jnp.float32)
+                                * mm).astype(zl.dtype)
+
+                    p_t = jax.tree.map(rebias, p_t)
+                return (p_t, m_t, mass_out, new_buf, new_buf_mass,
+                        pack_host_metrics(tl, ta, evalm, em, screened))
+
+            self._link_round_fn = jax.jit(link_round_fn,
+                                          donate_argnums=(0, 1, 2, 3, 4))
+
     def _run_blocked(self, rounds: int, block: int,
                      checkpoint_every: int = 0,
                      checkpoint_path=None) -> History:
@@ -747,7 +930,8 @@ class GossipTrainer:
                 limits = np.stack([p[2] for p in pairs])
                 frows = [p[4] for p in pairs]
                 plans = [
-                    make_batch_plan(self._train_matrix, batch_size=g.local_bs,
+                    make_batch_plan(self._plan_matrix_for_round(t),
+                                    batch_size=g.local_bs,
                                     local_ep=g.local_ep, seed=cfg.seed,
                                     round_idx=t, impl=cfg.data.plan_impl)
                     for t in ts
@@ -863,6 +1047,10 @@ class GossipTrainer:
         w_t = self._matrix_for_round(t)
         rf = self.faults.for_round(t)
         alive = (~rf.crashed).astype(np.float32)
+        away = self.faults.away_for_round(t)
+        if self.faults.has_churn:
+            rows.extend(churn_ledger_rows(self.faults, t, away))
+            alive = alive * (~away).astype(np.float32)
         if self._quarantine_on:
             expired = ((self._quarantine_until != 0)
                        & (t >= self._quarantine_until))
@@ -906,10 +1094,40 @@ class GossipTrainer:
                 rows.append({"round": int(t), "worker": int(i),
                              "kind": "corrupt",
                              "action": f"injected_{mode}"})
+        if self._link_mode:
+            # Per-edge link faults + the per-staleness matrix stack.
+            # Drops/delays apply to the surviving off-diagonal edges of
+            # the (crash/partition/churn-)repaired matrix; push-sum gets
+            # the mass-conserving column-stochastic effective matrix,
+            # plain gossip the row-renormalised (biased) one.
+            from dopt.topology import (push_sum_link_matrix,
+                                       repair_for_link_drop,
+                                       split_by_delay)
+
+            keep, delay = self.faults.link_for_round(t)
+            if self._has_link:
+                edges = (w_t * (1.0 - np.eye(self.num_workers))) > 0.0
+                for i, j in zip(*np.nonzero(edges & ~keep)):
+                    rows.append({"round": int(t), "worker": int(i),
+                                 "kind": "msg_drop",
+                                 "action": f"dropped_from_{int(j)}"})
+                for i, j in zip(*np.nonzero(edges & keep & (delay > 0))):
+                    rows.append({
+                        "round": int(t), "worker": int(i),
+                        "kind": "msg_delay",
+                        "action": f"delayed_from_{int(j)}_by_"
+                                  f"{int(delay[i, j])}"})
+            m_eff = (push_sum_link_matrix(w_t, keep) if self._push_sum
+                     else repair_for_link_drop(w_t, keep))
+            mats = split_by_delay(m_eff, delay, self._delay_max)
+            return mats, alive, limits, cmask, rows
         if self._shift_ids is not None:
             return (coeffs_for_matrix(w_t, self._shift_ids), alive, limits,
                     cmask, rows)
         return w_t.astype(np.float32), alive, limits, cmask, rows
+
+    def _plan_matrix_for_round(self, t: int) -> np.ndarray:
+        return self.faults.plan_matrix_for(t, self._train_matrix)
 
     def _apply_screen_feedback(self, t: int, alive, flags,
                                rows: list) -> None:
@@ -956,10 +1174,13 @@ class GossipTrainer:
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
         block = g.block_rounds if block is None else block
-        if block > 1 and not self._quarantine_on:
+        if block > 1 and not self._quarantine_on and not self._link_mode:
             # Quarantine stays per-round: the next round's alive mask
             # depends on THIS round's device-side screen flags, which a
-            # fused block only surfaces at its end.
+            # fused block only surfaces at its end.  Link-mode runs
+            # (msg_drop/msg_delay/push-sum) stay per-round too: the
+            # per-staleness matrix stack is host data per round and the
+            # staleness buffers ride the carried engine state.
             return self._run_blocked(rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
@@ -969,7 +1190,8 @@ class GossipTrainer:
             with self.timers.phase("host_batch_plan"):
                 w_t, alive, limits, cmask, frows = self._round_inputs(t)
                 plan = make_batch_plan(
-                    self._train_matrix, batch_size=g.local_bs, local_ep=g.local_ep,
+                    self._plan_matrix_for_round(t), batch_size=g.local_bs,
+                    local_ep=g.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
                 )
                 idx = jax.device_put(plan.idx, self._sharding)
@@ -977,14 +1199,27 @@ class GossipTrainer:
             do_eval = (t % self.eval_every) == 0
             step_kw = ({"cmask": jnp.asarray(cmask)}
                        if self._has_corrupt else {})
-            (self.params, self.momentum, self.x_hat,
-             packed) = self.timers.measure(
-                "round_step", self._round_fn,
-                self.params, self.momentum, self.x_hat, w_t, alive, limits,
-                jnp.asarray(t, jnp.int32), idx, bweight,
-                self._train_x, self._train_y, *self._eval, *self._val,
-                do_eval, **step_kw,
-            )
+            if self._link_mode:
+                (self.params, self.momentum, self._mass, self._link_buf,
+                 self._link_buf_mass, packed) = self.timers.measure(
+                    "round_step", self._link_round_fn,
+                    self.params, self.momentum, self._mass,
+                    self._link_buf, self._link_buf_mass,
+                    jnp.asarray(w_t), alive, limits,
+                    jnp.asarray(t, jnp.int32), idx, bweight,
+                    self._train_x, self._train_y, *self._eval, *self._val,
+                    do_eval, **step_kw,
+                )
+            else:
+                (self.params, self.momentum, self.x_hat,
+                 packed) = self.timers.measure(
+                    "round_step", self._round_fn,
+                    self.params, self.momentum, self.x_hat, w_t, alive,
+                    limits,
+                    jnp.asarray(t, jnp.int32), idx, bweight,
+                    self._train_x, self._train_y, *self._eval, *self._val,
+                    do_eval, **step_kw,
+                )
             tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
                 np.asarray(packed))  # ONE device→host fetch per round
             if self._robust_active:
@@ -1018,6 +1253,16 @@ class GossipTrainer:
         arrays = {"params": self.params, "momentum": self.momentum}
         if self.cfg.gossip.algorithm == "choco":
             arrays["x_hat"] = self.x_hat
+        if self._link_mode:
+            # Push-sum mass and the staleness buffers are carried engine
+            # state: without them a resumed lossy-link run would replay
+            # round t against the wrong in-flight/history snapshots.
+            if self._push_sum:
+                arrays["push_mass"] = {"mass": self._mass}
+            if self._delay_max > 0:
+                arrays["link_buf"] = self._link_buf
+                if self._push_sum:
+                    arrays["link_buf_mass"] = {"mass": self._link_buf_mass}
         save_checkpoint(
             path,
             arrays=arrays,
@@ -1049,6 +1294,37 @@ class GossipTrainer:
                     "choco trainer requires its public-copy state "
                     "('x_hat') in the checkpoint")
             self.x_hat = shard_worker_tree(arrays["x_hat"], self.mesh)
+        if self._link_mode:
+            if self._push_sum:
+                if "push_mass" not in arrays:
+                    raise ValueError(
+                        "push-sum trainer requires its mass vector "
+                        "('push_mass') in the checkpoint")
+                self._mass = jnp.asarray(arrays["push_mass"]["mass"])
+            if self._delay_max > 0:
+                if "link_buf" not in arrays:
+                    raise ValueError(
+                        "link-delay trainer requires its staleness "
+                        "buffer ('link_buf') in the checkpoint")
+                # Restore with the constructor's placement ([D, W, ...]
+                # sharded over the worker axis) so a resumed run feeds
+                # the compiled round fn identically-sharded inputs —
+                # a bare asarray would leave D full-model snapshots
+                # replicated per device.
+                buf_sharding = jax.sharding.NamedSharding(
+                    self.mesh,
+                    jax.sharding.PartitionSpec(None,
+                                               worker_axes(self.mesh)))
+                self._link_buf = jax.device_put(arrays["link_buf"],
+                                                buf_sharding)
+                if self._push_sum:
+                    if "link_buf_mass" not in arrays:
+                        raise ValueError(
+                            "push-sum + delay trainer requires the "
+                            "in-flight mass buffer ('link_buf_mass') in "
+                            "the checkpoint")
+                    self._link_buf_mass = jnp.asarray(
+                        arrays["link_buf_mass"]["mass"])
         self.round = int(meta["round"])
         self.history.rows = list(meta.get("history", []))
         self.history.faults = list(meta.get("fault_ledger", []))
@@ -1074,12 +1350,35 @@ class GossipTrainer:
                 "sequence will differ from the original pre-upgrade "
                 "run", stacklevel=2)
 
+    def _debiased_params(self):
+        """Device-resident per-worker parameter estimates: the carried
+        params, or — under ``correction='push_sum'``, where the carried
+        state is the NUMERATOR — the de-biased ratio estimates
+        params/mass (the quantity that converges to the true average
+        under lossy links).  The divide runs on device so callers never
+        pay a host round-trip for it."""
+        if not self._push_sum:
+            return self.params
+        mass = self._mass
+
+        def debias(x):
+            mm = jnp.maximum(mass, 1e-12).reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+            return (x.astype(jnp.float32) / mm).astype(x.dtype)
+
+        return jax.tree.map(debias, self.params)
+
+    def worker_params(self):
+        """Host copy of ``_debiased_params`` ([W, ...] pytree)."""
+        return jax.device_get(self._debiased_params())
+
     # Convenience: per-worker eval of the current state (reuses the
     # round step's evaluator — same wrapping, same jit cache).
     def evaluate(self) -> dict[str, np.ndarray]:
         """Reference-semantics eval: EVERY worker on the FULL test set,
         regardless of ``eval_mode`` (the sharded mode only changes the
-        in-training per-round metric)."""
+        in-training per-round metric).  Push-sum runs evaluate the
+        de-biased estimates."""
         if self._eval_full is None:
             ex, ey, ew = eval_batches(self.dataset.test_x,
                                       self.dataset.test_y,
@@ -1087,5 +1386,6 @@ class GossipTrainer:
                                                      256))
             self._eval_full = (jnp.asarray(ex), jnp.asarray(ey),
                                jnp.asarray(ew))
-        out = jax.jit(self._full_evaluator)(self.params, *self._eval_full)
+        out = jax.jit(self._full_evaluator)(self._debiased_params(),
+                                            *self._eval_full)
         return {k: np.asarray(v) for k, v in out.items()}

@@ -54,17 +54,21 @@ from dopt.utils.prng import host_rng
 # sampling/matching salts so enabling faults never perturbs them).
 _FAULT_SALT = 0xFA010
 _CRASH, _STRAGGLE, _PARTITION, _CORRUPT = 1, 2, 3, 4
+_LINK, _UPLINK, _CHURN, _STALE = 5, 6, 7, 8
 
 KINDS = ("crash", "straggler", "partition", "overselect", "corrupt",
-         "quarantine")
+         "quarantine", "msg_drop", "msg_delay", "churn", "staleness")
 CORRUPT_MODES = ("nan", "inf", "scale", "signflip", "stale")
 
 # The GossipConfig.dropout alias predates FaultPlan; warn once per
 # construction that FaultConfig(crash=p) is the spelling that survives.
+# crash=p is the degenerate all-links-down case of the per-edge link
+# model (a down worker = every in/out edge dropped + no local work);
+# tests/test_faults.py pins that routing equivalence.
 _DROPOUT_DEPRECATION = (
     "GossipConfig.dropout is deprecated: set "
     "ExperimentConfig.faults=FaultConfig(crash=p) instead (identical "
-    "fault trace; dropout will be removed in a future release)")
+    "fault trace; dropout will be REMOVED in release 0.2.0)")
 
 
 @dataclass(frozen=True)
@@ -125,7 +129,9 @@ class FaultPlan:
     def active(self) -> bool:
         c = self.cfg
         return c is not None and (c.crash > 0 or c.straggle > 0
-                                  or c.partition > 0 or c.corrupt > 0)
+                                  or c.partition > 0 or c.corrupt > 0
+                                  or c.msg_drop > 0 or c.msg_delay > 0
+                                  or c.churn > 0)
 
     @property
     def may_straggle(self) -> bool:
@@ -139,9 +145,32 @@ class FaultPlan:
 
     @property
     def affects_matrix(self) -> bool:
-        """Crash or partition repair can add identity rows to the mixing
-        matrix (the shift path must compile shift 0 into its set)."""
-        return self.active and (self.cfg.crash > 0 or self.cfg.partition > 0)
+        """Crash, partition or churn repair can add identity rows to the
+        mixing matrix (the shift path must compile shift 0 into its
+        set)."""
+        return self.active and (self.cfg.crash > 0 or self.cfg.partition > 0
+                                or self.cfg.churn > 0)
+
+    @property
+    def has_link(self) -> bool:
+        """Per-edge link faults possible (msg_drop / msg_delay): the
+        gossip engine then routes through the link-matrix consensus path
+        (dense, per-round) and the federated engine draws uplink
+        faults."""
+        return self.active and (self.cfg.msg_drop > 0
+                                or self.cfg.msg_delay > 0)
+
+    @property
+    def has_churn(self) -> bool:
+        """Elastic-membership leave/join events possible."""
+        return self.active and self.cfg.churn > 0
+
+    @property
+    def delay_max(self) -> int:
+        """Compiled staleness-buffer depth D: msg_delay_max when delays
+        are possible, else 0 (no buffer)."""
+        return (int(self.cfg.msg_delay_max)
+                if self.active and self.cfg.msg_delay > 0 else 0)
 
     # ------------------------------------------------------------------
     def _rng(self, kind: int, t: int) -> np.random.Generator:
@@ -191,6 +220,115 @@ class FaultPlan:
                 return groups.astype(np.int32)
         return None
 
+    # -- link faults (per-(round, directed edge) stateless draws) ------
+    def link_for_round(self, t: int) -> tuple[np.ndarray, np.ndarray]:
+        """(keep, delay) for round t's directed edges.
+
+        ``keep`` is bool [W, W]: keep[i, j] = the message j -> i
+        survives this round (diagonal always True — a worker never
+        drops its own state).  ``delay`` is int32 [W, W]: rounds of
+        staleness on edge j -> i, in {0..msg_delay_max} (0 on the
+        diagonal and on dropped edges — a dropped message never
+        arrives, late or otherwise).  Both directions of a link draw
+        independently, so loss/delay is asymmetric in general.  Draws
+        are keyed by (seed, _LINK, round) only — bit-reproducible,
+        blocked-exact and resume-exact like every other fault kind."""
+        w = self.num_workers
+        eye = np.eye(w, dtype=bool)
+        if not self.has_link:
+            return np.ones((w, w), bool), np.zeros((w, w), np.int32)
+        c = self.cfg
+        r = self._rng(_LINK, t)
+        # One fixed draw layout regardless of which knobs are on, so
+        # enabling msg_delay never perturbs the msg_drop trace.
+        u_drop = r.random((w, w))
+        u_del = r.random((w, w))
+        d_val = r.integers(1, max(c.msg_delay_max, 1) + 1, size=(w, w))
+        keep = ~((u_drop < c.msg_drop) & ~eye)
+        delayed = (u_del < c.msg_delay) & ~eye & keep
+        delay = np.where(delayed, d_val, 0).astype(np.int32)
+        return keep, delay
+
+    def uplink_for_round(self, t: int) -> tuple[np.ndarray, np.ndarray]:
+        """Federated worker -> server link faults for round t:
+        (dropped, delay) as [W] bool / int32 arrays.  ``dropped[i]``
+        loses worker i's update for the round; ``delay[i]`` > 0 means
+        the update arrives that many rounds late (admitted via the
+        staleness buffer when ``FederatedConfig.staleness_max`` allows,
+        dropped otherwise).  Drops win ties.  Separate salt from the
+        gossip edge draws so the two engines' traces are independent."""
+        w = self.num_workers
+        if not self.has_link:
+            return np.zeros(w, bool), np.zeros(w, np.int32)
+        c = self.cfg
+        r = self._rng(_UPLINK, t)
+        u_drop = r.random(w)
+        u_del = r.random(w)
+        d_val = r.integers(1, max(c.msg_delay_max, 1) + 1, size=w)
+        dropped = u_drop < c.msg_drop
+        delayed = (u_del < c.msg_delay) & ~dropped
+        return dropped, np.where(delayed, d_val, 0).astype(np.int32)
+
+    def straggler_lateness(self, t: int, max_late: int) -> np.ndarray:
+        """[W] int32 lateness draws in 1..max_late: how many rounds
+        after its deadline a buffered straggler's update arrives.  The
+        bound is the CALLER's admission window (federated
+        ``staleness_max``), not ``msg_delay_max`` — straggler lateness
+        is an aggregation-policy property, independent of whether the
+        message-delay fault is configured.  Keyed (seed, _STALE, round)
+        — stateless."""
+        w = self.num_workers
+        hi = max(int(max_late), 1)
+        return self._rng(_STALE, t).integers(1, hi + 1,
+                                             size=w).astype(np.int32)
+
+    # -- churn (elastic membership) ------------------------------------
+    def away_for_round(self, t: int) -> np.ndarray:
+        """[W] bool: workers away (departed) at round t.  Worker i is
+        away at t iff a leave event keyed at some round s in
+        (t - churn_span, t] fired for it — the same span-scan scheme as
+        partitions, so membership is a pure function of the round index
+        (stateless, resume-exact) and every leave lasts exactly
+        ``churn_span`` rounds before the rejoin."""
+        w = self.num_workers
+        if not self.has_churn:
+            return np.zeros(w, bool)
+        c = self.cfg
+        away = np.zeros(w, bool)
+        for s in range(int(t), max(int(t) - c.churn_span, -1), -1):
+            away |= self._rng(_CHURN, s).random(w) < c.churn
+        return away
+
+    def plan_matrix_for(self, t: int,
+                        train_matrix: np.ndarray) -> np.ndarray:
+        """Round t's batch-plan index matrix: ``train_matrix`` with
+        departed workers' shards deterministically reassigned to their
+        adopters while churn keeps them away (the engines' shared
+        shard-reassignment hook; a no-op without churn)."""
+        if not self.has_churn:
+            return train_matrix
+        from dopt.data.partition import reassign_shards
+
+        away = self.away_for_round(t)
+        return reassign_shards(train_matrix, self.adopters_for(away))
+
+    @staticmethod
+    def adopters_for(away: np.ndarray) -> dict[int, int]:
+        """Deterministic shard-reassignment map for a round's departed
+        set: each away worker i is adopted by the first alive worker at
+        (i+1, i+2, ...) mod W.  Empty when everyone (or no one) is
+        away."""
+        w = len(away)
+        if not away.any() or away.all():
+            return {}
+        out: dict[int, int] = {}
+        for i in np.nonzero(away)[0]:
+            j = (int(i) + 1) % w
+            while away[j]:
+                j = (j + 1) % w
+            out[int(i)] = j
+        return out
+
     # ------------------------------------------------------------------
     @staticmethod
     def limits_for(rf: RoundFaults, total_units: int) -> np.ndarray:
@@ -200,6 +338,30 @@ class FaultPlan:
         ``ceil(frac · total_units)`` (≥ 1 for frac > 0)."""
         lim = np.ceil(rf.epoch_frac * float(total_units))
         return np.clip(lim, 0, total_units).astype(np.int32)
+
+
+def churn_ledger_rows(plan: FaultPlan, t: int,
+                      away: np.ndarray) -> list[dict]:
+    """Elastic-membership ledger rows for round t: leave/rejoin
+    transitions and shard-adoption changes, recomputed statelessly from
+    the round index alone (so per-round, blocked and killed-and-resumed
+    execution log the identical trace).  Shared by both engines."""
+    rows: list[dict] = []
+    prev = (plan.away_for_round(t - 1) if t > 0
+            else np.zeros_like(away))
+    for i in np.nonzero(away & ~prev)[0]:
+        rows.append({"round": int(t), "worker": int(i), "kind": "churn",
+                     "action": "left"})
+    for i in np.nonzero(prev & ~away)[0]:
+        rows.append({"round": int(t), "worker": int(i), "kind": "churn",
+                     "action": "rejoined"})
+    adopters = plan.adopters_for(away)
+    prev_adopters = plan.adopters_for(prev)
+    for i, a in sorted(adopters.items()):
+        if prev_adopters.get(i) != a:
+            rows.append({"round": int(t), "worker": int(i), "kind": "churn",
+                         "action": f"shard_adopted_by_{a}"})
+    return rows
 
 
 def validate_fault_config(cfg: FaultConfig) -> None:
@@ -247,6 +409,21 @@ def validate_fault_config(cfg: FaultConfig) -> None:
             "non-finite poison)")
     if cfg.corrupt_max < 0:
         raise ValueError("FaultConfig.corrupt_max must be >= 0")
+    for f in ("msg_drop", "msg_delay", "churn"):
+        v = getattr(cfg, f)
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(f"FaultConfig.{f}={v} must be in [0, 1]")
+    if cfg.msg_drop == 1.0:
+        # msg_drop=1.0 cuts EVERY off-diagonal edge every round — no
+        # message ever arrives, which is 'nocons', not a lossy link.
+        raise ValueError(
+            "FaultConfig.msg_drop must be < 1 (dropping every message "
+            "every round leaves no communication to degrade; use "
+            "algorithm='nocons' for no-communication runs)")
+    if cfg.msg_delay_max < 1:
+        raise ValueError("FaultConfig.msg_delay_max must be >= 1")
+    if cfg.churn_span < 1:
+        raise ValueError("FaultConfig.churn_span must be >= 1")
 
 
 def parse_fault_spec(spec: str) -> FaultConfig:

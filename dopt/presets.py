@@ -269,6 +269,32 @@ PRESETS = {
                            corrupt_mode="scale", corrupt_scale=50.0),
         robust=RobustConfig(clip_radius=1.0, quarantine_after=3,
                             quarantine_rounds=5)),
+    # Degraded-network variants (PR 3): the same workloads over lossy,
+    # high-latency links with elastic membership.  Gossip: asymmetric
+    # per-edge message loss + bounded-staleness delays + churn, with the
+    # push-sum ratio-consensus correction so the fleet still converges
+    # to the UNBIASED average (plain gossip under asymmetric loss
+    # drifts to a biased one — tests/test_network.py).  Federated: a
+    # heavy straggler deadline + lossy/delayed uplinks + churn, with
+    # staleness-aware aggregation admitting late updates at decayed
+    # weight instead of hard-dropping them.
+    "baseline1-lossy": lambda: dataclasses.replace(
+        baseline_1_ring_mnist_mlp(),
+        name="baseline1-ring-mnist-mlp-lossy",
+        gossip=dataclasses.replace(baseline_1_ring_mnist_mlp().gossip,
+                                   correction="push_sum"),
+        faults=FaultConfig(msg_drop=0.15, msg_delay=0.2, msg_delay_max=2,
+                           churn=0.02, churn_span=3, crash=0.05)),
+    "baseline3-elastic": lambda: dataclasses.replace(
+        baseline_3_fedavg_noniid(),
+        name="baseline3-fedavg16-noniid-elastic",
+        federated=dataclasses.replace(baseline_3_fedavg_noniid().federated,
+                                      staleness_max=3,
+                                      staleness_decay=0.5),
+        faults=FaultConfig(straggle=0.5, straggle_frac=0.5,
+                           straggler_policy="drop", msg_drop=0.05,
+                           msg_delay=0.15, msg_delay_max=3, churn=0.02,
+                           churn_span=3, crash=0.05)),
 }
 
 

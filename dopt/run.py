@@ -121,8 +121,15 @@ def main(argv: list[str] | None = None) -> int:
                          "(dopt.faults.FaultPlan): comma-separated "
                          "FaultConfig fields, e.g. "
                          "'crash=0.1,straggle=0.2,straggle_frac=0.5,"
-                         "partition=0.05'; every injected fault is recorded "
-                         "in the run's fault ledger")
+                         "partition=0.05' or the lossy-link/elastic knobs "
+                         "'msg_drop=0.1,msg_delay=0.2,msg_delay_max=2,"
+                         "churn=0.02,churn_span=4'; every injected fault is "
+                         "recorded in the run's fault ledger.  Pair "
+                         "asymmetric msg_drop with --set "
+                         "gossip.correction=push_sum (bias-free consensus) "
+                         "and msg_delay/straggler drops with --set "
+                         "federated.staleness_max=K (late updates admitted "
+                         "with decay instead of lost)")
     ap.add_argument("--corrupt", default=None, metavar="SPEC",
                     help="inject Byzantine corruption (workers that LIE): "
                          "'p=0.25,mode=signflip,scale=50,max=2' or a bare "

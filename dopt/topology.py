@@ -469,6 +469,72 @@ def _repair_edges(w: np.ndarray, edge_mask: np.ndarray,
     return repaired
 
 
+def repair_for_link_drop(w: np.ndarray, keep: np.ndarray) -> np.ndarray:
+    """Rebuild a mixing matrix under per-DIRECTED-EDGE message loss
+    (the lossy-link model, ``FaultConfig.msg_drop``).
+
+    ``keep`` is bool [n, n]: keep[i, j] = the message j -> i arrived.
+    Dropped edges are removed and surviving rows renormalised (the
+    receiver re-weights what it actually heard — the only thing a real
+    receiver CAN do), with the ``repair_for_dropout`` healing semantics
+    for rows left empty.  The self-edge always survives (a worker never
+    loses its own state).
+
+    Correctness note: because each direction drops independently, the
+    repaired matrix is row-stochastic but in general NOT doubly
+    stochastic even when ``w`` was — plain gossip through it converges
+    to a *biased* weighted average.  ``push_sum_link_matrix`` is the
+    mass-conserving counterpart that keeps the true mean recoverable.
+
+    A worker with every in/out edge dropped is repaired exactly like a
+    crashed worker (identity row) — crash = the degenerate all-links
+    case, which is what lets the legacy ``GossipConfig.dropout`` alias
+    route through this path (pinned in tests/test_faults.py)."""
+    n = w.shape[0]
+    mask = (np.asarray(keep, bool) | np.eye(n, dtype=bool)).astype(w.dtype)
+    return _repair_edges(w, mask)
+
+
+def push_sum_link_matrix(w: np.ndarray, keep: np.ndarray) -> np.ndarray:
+    """Column-stochastic (mass-conserving) effective matrix for
+    push-sum / ratio consensus under message loss.
+
+    ``w`` is the round's (already crash/partition/churn-repaired)
+    row-stochastic mixing matrix; its transpose is the column-stochastic
+    out-share matrix B (sender j splits its mass by its own mixing row).
+    A dropped edge j -> i returns its share to the SENDER's self-term
+    (the message bounced; mass is never destroyed), so every column
+    still sums to exactly 1 and the ratio estimate params/mass stays a
+    convex combination of the honest values — the invariant the
+    push-sum property tests pin (Σ mass, nodes + in-flight, == n at
+    every round)."""
+    n = w.shape[0]
+    eye = np.eye(n, dtype=bool)
+    b = np.asarray(w, np.float64).T
+    k = (np.asarray(keep, bool) | eye)
+    m = b * k
+    # Undelivered share of each column back to the sender's diagonal.
+    lost = (b * ~k).sum(axis=0)
+    m[np.arange(n), np.arange(n)] += lost
+    return m
+
+
+def split_by_delay(m: np.ndarray, delay: np.ndarray,
+                   delay_max: int) -> np.ndarray:
+    """Split an effective mixing matrix into its per-staleness parts:
+    returns [D+1, n, n] with ``out[d] = m`` masked to the edges whose
+    message is d rounds stale (diagonal always d = 0; entries of
+    dropped edges are already 0 in ``m``).  ``sum(out, axis=0) == m``
+    exactly, so the split never changes the round's total weights —
+    only WHICH snapshot each weight applies to.  The input dtype is
+    preserved (push-sum's mass-conservation property tests run the
+    split in float64; the engines narrow to f32 at device put)."""
+    n = m.shape[0]
+    d = np.where(np.eye(n, dtype=bool), 0, np.asarray(delay))
+    out = np.stack([m * (d == k) for k in range(delay_max + 1)])
+    return out.astype(m.dtype)
+
+
 def repair_for_partition(w: np.ndarray, groups: np.ndarray) -> np.ndarray:
     """Rebuild a mixing matrix under a network partition: edges that
     cross the cut are removed and surviving rows renormalised, exactly

@@ -67,6 +67,20 @@ class History:
     def faults_to_json(self, path: str | Path) -> Path:
         return atomic_write_text(path, json.dumps(self.faults, indent=2))
 
+    @staticmethod
+    def faults_from_json(path: str | Path) -> list[dict[str, Any]]:
+        """Re-load a ``--faults-json`` export.  Round-trips the in-
+        ``History`` ledger row-for-row (the schema is plain
+        int/str scalars), so exported traces stay audit-complete —
+        pinned by tests/test_network.py's round-trip test."""
+        with open(path) as f:
+            rows = json.load(f)
+        if not isinstance(rows, list) or any(
+                not isinstance(r, dict) for r in rows):
+            raise ValueError(f"{path}: not a fault-ledger export "
+                             "(expected a JSON list of row objects)")
+        return rows
+
     def __len__(self) -> int:
         return len(self.rows)
 

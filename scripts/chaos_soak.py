@@ -1,0 +1,240 @@
+"""Chaos soak: a randomized fault cocktail, end-to-end, with invariants.
+
+Runs both engines through crash + corrupt + straggler + msg_drop +
+msg_delay + churn simultaneously (the full degraded-network regime from
+``dopt.faults``) on a small synthetic workload and asserts the three
+things a robust trainer owes you:
+
+1. **Convergence to tolerance** — the fleet still learns: final train
+   loss beats the first round's by a margin, and every logged metric is
+   finite (the defenses keep poison out of theta).
+2. **Ledger invariants** — every fault row is schema-complete
+   ({round, worker, kind, action}, kind in ``dopt.faults.KINDS``, ids
+   in range), and a rerun of the identical config reproduces the
+   ledger row-for-row (the stateless-draw determinism contract).
+3. **Checkpoint invariants** — a run killed mid-soak and resumed from
+   its latest auto-checkpoint is bit-identical (History rows AND fault
+   ledger) to the continuous run.  ``--kill`` does this the honest way:
+   it spawns a child process, SIGKILLs it mid-round-loop, and resumes
+   from whatever checkpoint survived; the default does the same
+   in-process (deterministic, CI-friendly).
+
+The cocktail's knobs are drawn from seeded ranges (``--seed``), so
+``--seed N`` gives N distinct-but-reproducible storms.
+
+    python scripts/chaos_soak.py --rounds 8 --seed 0
+    python scripts/chaos_soak.py --rounds 8 --engine gossip --kill
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,  # noqa: E402
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig)
+from dopt.faults import KINDS  # noqa: E402
+
+_DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
+                   synthetic_train_size=512, synthetic_test_size=128)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
+
+
+def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig]:
+    """Seeded random draw of the round's fault cocktail: (gossip
+    cocktail, federated cocktail).  The federated one adds the
+    Byzantine nan liar (screened by the always-on non-finite guard) and
+    the heavy straggler deadline that staleness-aware aggregation
+    buffers; the gossip one leans on the link model + push-sum."""
+    rng = np.random.default_rng([0xC0C7A11, seed])
+
+    def u(lo, hi):
+        return float(rng.uniform(lo, hi))
+
+    gossip = FaultConfig(
+        crash=u(0.03, 0.1), straggle=u(0.1, 0.3), straggle_frac=0.5,
+        msg_drop=u(0.1, 0.25), msg_delay=u(0.1, 0.35), msg_delay_max=2,
+        churn=u(0.02, 0.08), churn_span=int(rng.integers(2, 4)))
+    fed = FaultConfig(
+        crash=u(0.03, 0.1), straggle=u(0.3, 0.6), straggle_frac=0.5,
+        straggler_policy="drop", over_select=0.3,
+        corrupt=u(0.05, 0.15), corrupt_mode="nan",
+        msg_drop=u(0.05, 0.15), msg_delay=u(0.1, 0.3), msg_delay_max=3,
+        churn=u(0.02, 0.08), churn_span=int(rng.integers(2, 4)))
+    return gossip, fed
+
+
+def build_cfg(engine: str, seed: int, rounds: int) -> ExperimentConfig:
+    gossip_fc, fed_fc = cocktail(seed)
+    if engine == "gossip":
+        return ExperimentConfig(
+            name=f"chaos-gossip-{seed}", seed=100 + seed, data=_DATA,
+            model=_MODEL, optim=_OPTIM,
+            gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                                mode="metropolis", rounds=rounds,
+                                local_ep=1, local_bs=32,
+                                correction="push_sum"),
+            faults=gossip_fc)
+    return ExperimentConfig(
+        name=f"chaos-fed-{seed}", seed=100 + seed, data=_DATA,
+        model=_MODEL, optim=_OPTIM,
+        federated=FederatedConfig(algorithm="fedavg", frac=0.5,
+                                  rounds=rounds, local_ep=1, local_bs=32,
+                                  staleness_max=3, staleness_decay=0.5),
+        faults=fed_fc)
+
+
+def build_trainer(engine: str, seed: int, rounds: int):
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    cfg = build_cfg(engine, seed, rounds)
+    return (GossipTrainer(cfg) if engine == "gossip"
+            else FederatedTrainer(cfg))
+
+
+def check_ledger(history, rounds: int, workers: int) -> int:
+    """Schema + range invariants over every fault-ledger row."""
+    for row in history.faults:
+        assert set(row) == {"round", "worker", "kind", "action"}, row
+        assert row["kind"] in KINDS, row
+        assert 0 <= row["round"] < rounds, row
+        assert 0 <= row["worker"] < workers, row
+        assert isinstance(row["action"], str) and row["action"], row
+    return len(history.faults)
+
+
+def loss_key(history) -> str:
+    return ("avg_train_loss" if "avg_train_loss" in history.rows[0]
+            else "train_loss")
+
+
+def check_convergence(history, tol: float) -> tuple[float, float]:
+    k = loss_key(history)
+    losses = [r[k] for r in history.rows if k in r]
+    assert all(np.isfinite(v) for r in history.rows for v in r.values()), \
+        "non-finite metric leaked into History"
+    first, last = float(losses[0]), float(losses[-1])
+    assert last < first + tol, \
+        f"no learning under the cocktail: first={first:.4f} last={last:.4f}"
+    return first, last
+
+
+def soak_one(engine: str, seed: int, rounds: int, tol: float,
+             ckpt_dir: str, kill: bool) -> None:
+    w = _DATA.num_users
+    print(f"[{engine}] cocktail seed={seed}: continuous run ...")
+    cont = build_trainer(engine, seed, rounds)
+    hc = cont.run(rounds=rounds)
+    first, last = check_convergence(hc, tol)
+    n_rows = check_ledger(hc, rounds, w)
+    print(f"[{engine}] loss {first:.4f} -> {last:.4f}, "
+          f"{n_rows} ledger rows, kinds "
+          f"{sorted(set(r['kind'] for r in hc.faults))}")
+
+    # Determinism: the identical config replays the identical storm.
+    rerun = build_trainer(engine, seed, rounds)
+    hr = rerun.run(rounds=rounds)
+    assert hr.rows == hc.rows and hr.faults == hc.faults, \
+        "rerun diverged from the first run (stateless-draw contract broken)"
+    print(f"[{engine}] deterministic replay ok")
+
+    # Kill-and-resume bit-identity.
+    path = os.path.join(ckpt_dir, f"{engine}-{seed}")
+    kill_at = max(rounds // 2, 1)
+    if kill:
+        _sigkill_child(engine, seed, rounds, kill_at, path)
+    else:
+        part = build_trainer(engine, seed, rounds)
+        part.run(rounds=kill_at, checkpoint_every=1, checkpoint_path=path)
+    res = build_trainer(engine, seed, rounds)
+    res.restore(path)
+    assert res.round >= 1, "no checkpoint survived the kill"
+    hk = res.run(rounds=rounds - res.round)
+    assert hk.rows == hc.rows, \
+        f"resumed History diverged from continuous ({engine})"
+    assert hk.faults == hc.faults, \
+        f"resumed fault ledger diverged from continuous ({engine})"
+    print(f"[{engine}] {'SIGKILL' if kill else 'in-process kill'}"
+          f"-and-resume bit-identical ok")
+
+
+def _sigkill_child(engine: str, seed: int, rounds: int, kill_at: int,
+                   path: str) -> None:
+    """Spawn this script as a child running the soak config with
+    per-round auto-checkpoints, SIGKILL it once it reports ``kill_at``
+    completed rounds, and leave its latest checkpoint for the caller."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", engine,
+           "--seed", str(seed), "--rounds", str(rounds), "--ckpt", path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                             env=env)
+    try:
+        for line in child.stdout:
+            if line.startswith("ROUND "):
+                done = int(line.split()[1]) + 1
+                if done >= kill_at:
+                    os.kill(child.pid, signal.SIGKILL)
+                    break
+    finally:
+        child.stdout.close()
+        child.wait()
+    # Give the filesystem a beat; the checkpoint write itself is atomic
+    # (temp dir + rename), so whatever is at `path` is complete.
+    time.sleep(0.2)
+
+
+def child_main(engine: str, seed: int, rounds: int, path: str) -> int:
+    trainer = build_trainer(engine, seed, rounds)
+    for _ in range(rounds):
+        trainer.run(rounds=1, checkpoint_every=1, checkpoint_path=path)
+        print(f"ROUND {trainer.round - 1}", flush=True)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="cocktail seed (each seed is a different storm)")
+    ap.add_argument("--engine", choices=["both", "gossip", "federated"],
+                    default="both")
+    ap.add_argument("--tol", type=float, default=0.0,
+                    help="slack added to the final-loss-beats-first check")
+    ap.add_argument("--kill", action="store_true",
+                    help="kill-and-resume via a real SIGKILLed subprocess "
+                         "instead of the in-process stop")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint scratch dir (default: a temp dir)")
+    ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child_main(args.child, args.seed, args.rounds, args.ckpt)
+
+    import tempfile
+
+    engines = (["gossip", "federated"] if args.engine == "both"
+               else [args.engine])
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt_dir = args.ckpt_dir or tmp
+        for engine in engines:
+            soak_one(engine, args.seed, args.rounds, args.tol, ckpt_dir,
+                     args.kill)
+    print("chaos soak passed: convergence + ledger + checkpoint "
+          "invariants hold under the full cocktail")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

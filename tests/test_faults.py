@@ -90,16 +90,19 @@ def test_faultplan_dropout_alias():
 
 def test_dropout_alias_deprecation_and_trace_parity(devices):
     # Retirement contract for the GossipConfig.dropout alias: trainer
-    # construction warns ONCE (DeprecationWarning), and the run's
-    # History + fault ledger are identical to the explicit
-    # FaultConfig(crash=p) spelling — so the alias can be dropped in a
-    # later PR with a pure find-and-replace migration.
+    # construction warns ONCE (DeprecationWarning) NAMING the removal
+    # release, and the run's History + fault ledger are identical to
+    # the explicit FaultConfig(crash=p) spelling — so the alias can be
+    # dropped in release 0.2.0 with a pure find-and-replace migration.
     import warnings
 
     from dopt.engine import GossipTrainer
 
-    with pytest.warns(DeprecationWarning, match="dropout is deprecated"):
+    with pytest.warns(DeprecationWarning,
+                      match="dropout is deprecated") as rec:
         legacy = GossipTrainer(_gossip_cfg(None, dropout=0.3))
+    assert any("0.2.0" in str(w.message) for w in rec), \
+        "deprecation warning must name the removal release"
     with warnings.catch_warnings():
         warnings.simplefilter("error", DeprecationWarning)
         explicit = GossipTrainer(_gossip_cfg(FaultConfig(crash=0.3)))
@@ -107,6 +110,19 @@ def test_dropout_alias_deprecation_and_trace_parity(devices):
     he = explicit.run(rounds=2)
     assert hl.rows == he.rows
     assert hl.faults == he.faults and hl.faults
+    # The alias routes through the link-fault model: a crashed worker is
+    # the degenerate all-links-down case, so the crash repair the alias
+    # triggers equals cutting every in/out edge on the per-edge path.
+    from dopt.topology import repair_for_dropout, repair_for_link_drop
+
+    w_t = legacy._matrix_for_round(0)
+    rf = legacy.faults.for_round(0)
+    alive = (~rf.crashed).astype(np.float32)
+    dead = rf.crashed
+    keep = ~(dead[:, None] | dead[None, :])
+    np.testing.assert_allclose(repair_for_dropout(w_t, alive),
+                               repair_for_link_drop(w_t, keep),
+                               atol=1e-12)
 
 
 @pytest.mark.parametrize("bad", [
@@ -114,6 +130,9 @@ def test_dropout_alias_deprecation_and_trace_parity(devices):
     {"straggle": 0.5, "straggle_frac": 0.0},
     {"straggler_policy": "retry"}, {"over_select": -1.0},
     {"partition_span": 0}, {"partition_groups": 1},
+    {"msg_drop": -0.1}, {"msg_drop": 1.0}, {"msg_delay": 1.5},
+    {"msg_delay": 0.2, "msg_delay_max": 0}, {"churn": 2.0},
+    {"churn": 0.1, "churn_span": 0},
 ])
 def test_faultplan_validation(bad):
     with pytest.raises(ValueError):
@@ -169,7 +188,13 @@ def test_parse_fault_spec():
     with pytest.raises(ValueError, match="expects"):
         parse_fault_spec("crash=lots")
     assert set(KINDS) == {"crash", "straggler", "partition", "overselect",
-                          "corrupt", "quarantine"}
+                          "corrupt", "quarantine", "msg_drop", "msg_delay",
+                          "churn", "staleness"}
+    # the lossy-link / elastic-membership fields parse like any other
+    cfg2 = parse_fault_spec(
+        "msg_drop=0.1,msg_delay=0.2,msg_delay_max=3,churn=0.05,churn_span=2")
+    assert cfg2.msg_drop == 0.1 and cfg2.msg_delay_max == 3
+    assert cfg2.churn == 0.05 and cfg2.churn_span == 2
 
 
 # ---------------------------------------------------------------------------

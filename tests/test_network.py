@@ -1,0 +1,599 @@
+"""Lossy-link network model, push-sum correction, staleness & churn.
+
+Four layers, mirroring tests/test_faults.py's structure:
+
+* host-only link/churn draw semantics (stateless per-(round, edge),
+  asymmetric, resume-exact) and the matrix builders' invariants —
+  row-stochasticity after drop repair, exact mass conservation of the
+  push-sum effective matrix, delay-split completeness;
+* the CORRECTNESS win the tentpole exists for: under asymmetric
+  message loss, plain (row-renormalised) gossip converges to a BIASED
+  average while ``correction='push_sum'`` recovers the true mean to
+  tolerance — asserted both on a pure-numpy packet simulation of the
+  exact per-round matrices and end-to-end through ``GossipTrainer``
+  on an lr=0 consensus task;
+* staleness-aware aggregation beating hard straggler drop on final
+  loss under a heavy-straggler federated config;
+* the ledger round-trip (``--faults-json`` export == in-``History``
+  ledger row-for-row, link-fault rows included) and the
+  ``GossipConfig.dropout`` retirement contract (release named in the
+  warning, alias routes through the link-fault repair path).
+
+Heavyweight end-to-end soaks (full cocktail, SIGKILL resume) live in
+``scripts/chaos_soak.py``; its smoke test here is marked ``slow``.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig)
+from dopt.faults import KINDS, FaultPlan, churn_ledger_rows
+from dopt.topology import (build_mixing_matrices, push_sum_link_matrix,
+                           repair_for_dropout, repair_for_link_drop,
+                           split_by_delay)
+
+pytestmark = pytest.mark.network
+
+
+# ---------------------------------------------------------------------------
+# Link/churn draw semantics (host-only, stateless)
+# ---------------------------------------------------------------------------
+
+def _link_plan(w=8, **kw):
+    base = dict(msg_drop=0.3, msg_delay=0.4, msg_delay_max=2)
+    base.update(kw)
+    return FaultPlan(w, FaultConfig(**base), seed=5)
+
+
+def test_link_draws_stateless_and_asymmetric():
+    a, b = _link_plan(), _link_plan()
+    saw_asym = False
+    for t in (4, 0, 2, 4):
+        ka, da = a.link_for_round(t)
+        kb, db = b.link_for_round(t)
+        np.testing.assert_array_equal(ka, kb)
+        np.testing.assert_array_equal(da, db)
+        # the self-edge never drops or delays
+        assert ka.diagonal().all() and not da.diagonal().any()
+        # a dropped message never arrives late
+        assert not (da[~ka] != 0).any()
+        assert da.min() >= 0 and da.max() <= 2
+        saw_asym |= bool((ka != ka.T).any())
+    # directed draws: loss is asymmetric somewhere in 4 rounds of a
+    # 30% drop rate (probability of full symmetry is negligible)
+    assert saw_asym
+
+
+def test_link_inactive_is_all_kept():
+    plan = FaultPlan(6, FaultConfig(crash=0.5), seed=1)
+    assert not plan.has_link and plan.delay_max == 0
+    keep, delay = plan.link_for_round(3)
+    assert keep.all() and not delay.any()
+    up_drop, up_delay = plan.uplink_for_round(3)
+    assert not up_drop.any() and not up_delay.any()
+
+
+def test_churn_membership_stateless_and_span():
+    plan = FaultPlan(10, FaultConfig(churn=0.15, churn_span=3), seed=9)
+    away = {t: plan.away_for_round(t) for t in range(30)}
+    # stateless: a second plan replays the identical membership
+    plan2 = FaultPlan(10, FaultConfig(churn=0.15, churn_span=3), seed=9)
+    for t in range(30):
+        np.testing.assert_array_equal(away[t], plan2.away_for_round(t))
+    # every departure lasts at least... the union-of-spans scheme keeps
+    # a worker away while ANY leave event in the last churn_span rounds
+    # covers it, so each leave start implies >= churn_span away rounds
+    # were it the only event — check the weaker invariant that each
+    # transition to away persists while its start event is in scope.
+    starts = [(t, i) for t in range(1, 30)
+              for i in np.nonzero(away[t] & ~away[t - 1])[0]]
+    assert starts, "expected churn events in 30 rounds"
+    for t, i in starts:
+        for u in range(t, min(t + 3, 30)):
+            assert away[u][i], "membership flapped inside the span"
+
+
+def test_adopters_and_reassign_shards():
+    from dopt.data import reassign_shards
+
+    away = np.array([False, True, True, False, False])
+    ad = FaultPlan.adopters_for(away)
+    assert ad == {1: 3, 2: 3}   # next alive after 1 is 3 (2 is away)
+    assert FaultPlan.adopters_for(np.zeros(4, bool)) == {}
+    assert FaultPlan.adopters_for(np.ones(4, bool)) == {}
+    mat = np.arange(20, dtype=np.int32).reshape(4, 5) * 10
+    out = reassign_shards(mat, {1: 3, 2: 3})
+    np.testing.assert_array_equal(out[0], mat[0])   # untouched rows
+    np.testing.assert_array_equal(out[1], mat[1])
+    # adopter row: round-robin interleave of its own + both adopted
+    # shards, truncated to L — covers all three evenly
+    assert set(out[3]).issubset(set(mat[1]) | set(mat[2]) | set(mat[3]))
+    assert len(set(out[3]) & set(mat[1])) >= 1
+    assert len(set(out[3]) & set(mat[2])) >= 1
+    assert len(set(out[3]) & set(mat[3])) >= 1
+    np.testing.assert_array_equal(mat[3], np.arange(15, 20) * 10)  # no mutation
+
+
+def test_churn_ledger_rows_transitions_only():
+    plan = FaultPlan(8, FaultConfig(churn=0.2, churn_span=2), seed=3)
+    seen = set()
+    for t in range(20):
+        for row in churn_ledger_rows(plan, t, plan.away_for_round(t)):
+            assert row["kind"] == "churn"
+            seen.add(row["action"].split("_")[0])
+    assert "left" in seen and "rejoined" in seen
+
+
+# ---------------------------------------------------------------------------
+# Matrix builders: drop repair, mass conservation, delay split
+# ---------------------------------------------------------------------------
+
+def _base_matrix(n=8, seed=0):
+    return build_mixing_matrices("complete", "metropolis", n,
+                                 seed=seed).matrices[0]
+
+
+def test_repair_for_link_drop_row_stochastic_not_doubly():
+    rng = np.random.default_rng(0)
+    for seed in range(6):
+        w = _base_matrix(seed=seed)
+        n = w.shape[0]
+        keep = rng.random((n, n)) > 0.4
+        r = repair_for_link_drop(w, keep)
+        np.testing.assert_allclose(r.sum(axis=1), 1.0, atol=1e-9)
+        off = ~(keep | np.eye(n, dtype=bool))
+        assert np.all(r[off] == 0.0)
+    # asymmetric drops break double-stochasticity — the bias mechanism
+    w = _base_matrix(seed=1)
+    keep = np.ones_like(w, bool)
+    keep[0, 1] = False          # 1 -> 0 lost, 0 -> 1 survives
+    r = repair_for_link_drop(w, keep)
+    assert abs(r.sum(axis=0) - 1.0).max() > 1e-3
+
+
+def test_full_link_drop_equals_crash_repair():
+    # crash = the degenerate all-links-down case: repairing around a
+    # dead worker's cut edges reproduces repair_for_dropout exactly —
+    # the routing contract that lets the GossipConfig.dropout alias
+    # retire onto the link-fault path.
+    for seed in range(4):
+        w = _base_matrix(seed=seed)
+        n = w.shape[0]
+        rng = np.random.default_rng(seed)
+        alive = (rng.random(n) < 0.6).astype(np.float32)
+        if alive.sum() == 0:
+            alive[0] = 1.0
+        dead = alive <= 0
+        keep = ~(dead[:, None] | dead[None, :])
+        np.testing.assert_allclose(repair_for_link_drop(w, keep),
+                                   repair_for_dropout(w, alive),
+                                   atol=1e-12)
+
+
+def test_push_sum_link_matrix_conserves_mass():
+    rng = np.random.default_rng(7)
+    for seed in range(6):
+        w = _base_matrix(seed=seed)
+        keep = rng.random(w.shape) > 0.5
+        m = push_sum_link_matrix(w, keep)
+        np.testing.assert_allclose(m.sum(axis=0), 1.0, atol=1e-12)
+        assert m.min() >= 0.0
+
+
+def test_split_by_delay_partitions_exactly():
+    rng = np.random.default_rng(3)
+    w = _base_matrix(seed=2)
+    keep = rng.random(w.shape) > 0.3
+    m = push_sum_link_matrix(w, keep)
+    delay = rng.integers(0, 3, size=w.shape)
+    mats = split_by_delay(m, delay, 2)
+    assert mats.shape == (3, *w.shape)
+    np.testing.assert_allclose(mats.sum(axis=0), m, atol=1e-6)
+    # the diagonal is always immediate
+    np.testing.assert_allclose(np.diagonal(mats[1]), 0.0)
+    np.testing.assert_allclose(np.diagonal(mats[2]), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# The correctness win, numpy packet simulation of the exact round math
+# ---------------------------------------------------------------------------
+
+def _simulate(plan, w0, x0, rounds, correction, delay_max):
+    """Pure-numpy replica of the engines' link consensus: returns
+    (estimates [W], mass [W], total_mass_trace).  x0 is [W] (one scalar
+    coordinate per worker — consensus is coordinate-wise linear, so one
+    coordinate captures the math)."""
+    n = len(x0)
+    x = x0.astype(np.float64).copy()
+    if correction == "push_sum":
+        mass = np.ones(n)
+        buf_x = np.zeros((delay_max, n)) if delay_max else None
+        buf_m = np.zeros((delay_max, n)) if delay_max else None
+    else:
+        hist = (np.stack([x0] * delay_max) if delay_max else None)
+    trace = []
+    for t in range(rounds):
+        keep, delay = plan.link_for_round(t)
+        if correction == "push_sum":
+            m = push_sum_link_matrix(w0, keep)
+            mats = split_by_delay(m, delay, delay_max)
+            now_x = mats[0] @ x
+            now_m = mats[0] @ mass
+            if delay_max:
+                now_x += buf_x[0]
+                now_m += buf_m[0]
+                arr_x = np.stack([mats[d] @ x
+                                  for d in range(1, delay_max + 1)])
+                arr_m = np.stack([mats[d] @ mass
+                                  for d in range(1, delay_max + 1)])
+                buf_x = np.vstack([buf_x[1:], np.zeros((1, n))]) + arr_x
+                buf_m = np.vstack([buf_m[1:], np.zeros((1, n))]) + arr_m
+            x, mass = now_x, now_m
+            inflight = buf_m.sum() if delay_max else 0.0
+            trace.append(mass.sum() + inflight)
+        else:
+            m = repair_for_link_drop(w0, keep)
+            mats = split_by_delay(m, delay, delay_max)
+            nxt = mats[0] @ x
+            if delay_max:
+                for d in range(1, delay_max + 1):
+                    nxt += mats[d] @ hist[d - 1]
+                hist = np.vstack([x[None], hist[:-1]])
+            x = nxt
+    if correction == "push_sum":
+        return x / np.maximum(mass, 1e-300), mass, np.asarray(trace)
+    return x, np.ones(n), np.asarray(trace)
+
+
+def test_pushsum_unbiased_plain_biased_under_asymmetric_drop():
+    n = 8
+    w0 = _base_matrix(n)
+    plan = _link_plan(n, msg_drop=0.3, msg_delay=0.3, msg_delay_max=2)
+    rng = np.random.default_rng(1)
+    x0 = rng.normal(size=n)
+    true_mean = x0.mean()
+    est_p, mass, trace = _simulate(plan, w0, x0, 400, "push_sum", 2)
+    est_n, _, _ = _simulate(plan, w0, x0, 400, "none", 2)
+    # push-sum: node mass + in-flight mass conserved at exactly n every
+    # round, and the ratio estimate recovers the true mean
+    np.testing.assert_allclose(trace, n, rtol=1e-7)
+    np.testing.assert_allclose(est_p, true_mean, atol=1e-6)
+    # plain gossip reached consensus — on the WRONG value
+    assert np.ptp(est_n) < 1e-6
+    assert abs(est_n.mean() - true_mean) > 1e-3
+
+
+def test_pushsum_fixed_theta_consensus_exact():
+    # every worker already agrees: drops/delays must not move anyone
+    # (each packet's value mass is theta x its weight mass)
+    n = 6
+    w0 = _base_matrix(n)
+    plan = _link_plan(n, msg_drop=0.4, msg_delay=0.5, msg_delay_max=2)
+    x0 = np.full(n, 2.5)
+    est, mass, trace = _simulate(plan, w0, x0, 60, "push_sum", 2)
+    np.testing.assert_allclose(est, 2.5, atol=1e-9)
+    np.testing.assert_allclose(trace, n, rtol=1e-7)
+
+
+# Property-based sweep (hypothesis; guarded import as in
+# test_topology_properties.py — the seeded sweeps above cover the same
+# invariants without the dependency).
+try:
+    from hypothesis import given, settings, strategies as st
+    _HAVE_HYP = True
+except ImportError:                                    # pragma: no cover
+    _HAVE_HYP = False
+
+
+if _HAVE_HYP:
+    @settings(max_examples=25, deadline=None)
+    @given(n=st.integers(4, 9), seed=st.integers(0, 2**16),
+           drop=st.floats(0.0, 0.6), delay=st.floats(0.0, 0.8),
+           dmax=st.integers(1, 3))
+    def test_pushsum_mass_conserved_under_arbitrary_traces(
+            n, seed, drop, delay, dmax):
+        w0 = _base_matrix(n, seed=seed)
+        plan = FaultPlan(n, FaultConfig(msg_drop=min(drop, 0.99),
+                                        msg_delay=delay,
+                                        msg_delay_max=dmax), seed=seed)
+        rng = np.random.default_rng(seed)
+        x0 = rng.normal(size=n)
+        rounds = 12
+        est, mass, trace = _simulate(plan, w0, x0, rounds, "push_sum",
+                                     plan.delay_max)
+        # mass (nodes + in-flight) sums to n at EVERY round
+        np.testing.assert_allclose(trace, n, rtol=1e-10)
+        assert mass.min() > 0
+        # the ratio estimate stays inside the convex hull of x0 —
+        # unbiasedness's finite-round form (exact-mean recovery is the
+        # 400-round test above)
+        assert est.min() >= x0.min() - 1e-8
+        assert est.max() <= x0.max() + 1e-8
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (tiny logistic configs — tier-1 budget friendly)
+# ---------------------------------------------------------------------------
+
+_LDATA = DataConfig(dataset="synthetic", num_users=6, iid=True,
+                    synthetic_train_size=96, synthetic_test_size=24)
+_LMODEL = ModelConfig(model="logistic", num_classes=2, input_shape=(8,),
+                      faithful=False)
+
+
+def _gossip_cfg(faults=None, lr=0.0, **gkw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=16)
+    g.update(gkw)
+    return ExperimentConfig(name="t", seed=11, data=_LDATA, model=_LMODEL,
+                            optim=OptimizerConfig(lr=lr, momentum=0.0),
+                            gossip=GossipConfig(**g), faults=faults)
+
+
+def _perturbed(trainer, seed=0):
+    """Give each worker distinct parameters (they all share one init) so
+    consensus has something to average; returns the true mean tree."""
+    import jax
+
+    from dopt.parallel.mesh import shard_worker_tree
+
+    rng = np.random.default_rng(seed)
+    host = jax.device_get(trainer.params)
+    pert = jax.tree.map(
+        lambda x: (x + rng.normal(0, 1, x.shape)).astype(x.dtype), host)
+    trainer.params = shard_worker_tree(pert, trainer.mesh)
+    return jax.tree.map(lambda x: x.mean(0), pert)
+
+
+def test_engine_pushsum_recovers_true_mean_plain_biased(devices):
+    # THE acceptance criterion: an lr=0 consensus task under asymmetric
+    # msg_drop.  Plain gossip reaches consensus on a biased value;
+    # correction='push_sum' recovers the true initial mean to tolerance.
+    import jax
+
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.3)
+    errs = {}
+    for corr in ("none", "push_sum"):
+        tr = GossipTrainer(_gossip_cfg(fc, correction=corr))
+        tm = _perturbed(tr)
+        tr.run(rounds=40)
+        est = tr.worker_params()
+        errs[corr] = max(jax.tree.leaves(jax.tree.map(
+            lambda e, m: float(np.abs(e - m[None]).max()), est, tm)))
+        spread = max(jax.tree.leaves(jax.tree.map(
+            lambda e: float(np.ptp(e, axis=0).max()), est)))
+        assert spread < 1e-3, f"{corr}: no consensus reached"
+    assert errs["push_sum"] < 1e-3, errs
+    assert errs["none"] > 10 * errs["push_sum"], errs
+    # mass conservation end-to-end (no delays -> no in-flight component)
+    tr_mass = np.asarray(tr._mass)
+    np.testing.assert_allclose(tr_mass.sum(), 6.0, rtol=1e-5)
+
+
+def test_staleness_beats_hard_drop_on_final_loss(devices):
+    # Heavy straggler deadline: 80% of sampled clients miss it every
+    # round.  Hard drop discards their work; staleness-aware
+    # aggregation admits it a round or two late with decay weighting
+    # and must end at a strictly better training loss.
+    from dopt.engine import FederatedTrainer
+
+    data = dataclasses.replace(_LDATA, num_users=8,
+                               synthetic_train_size=256)
+    # straggle=1.0: EVERY sampled client misses the server deadline
+    # every round — hard drop aggregates nothing (theta frozen at
+    # init), staleness-aware admission recovers the training run.
+    base = FaultConfig(straggle=1.0, straggle_frac=0.5,
+                       straggler_policy="drop", msg_delay_max=2)
+
+    def cfg(**fkw):
+        f = dict(algorithm="fedavg", frac=1.0, rounds=6, local_ep=1,
+                 local_bs=16)
+        f.update(fkw)
+        return ExperimentConfig(name="t", seed=3, data=data, model=_LMODEL,
+                                optim=OptimizerConfig(lr=0.3, momentum=0.5),
+                                federated=FederatedConfig(**f), faults=base)
+
+    h_drop = FederatedTrainer(cfg()).run(rounds=6)
+    h_stale = FederatedTrainer(
+        cfg(staleness_max=2, staleness_decay=0.7)).run(rounds=6)
+    assert any(r["kind"] == "staleness" for r in h_stale.faults)
+    # the global model is what staleness admission moves (per-worker
+    # carried params keep drop semantics); under the universal deadline
+    # miss, hard drop's theta never leaves init
+    assert h_stale.rows[-1]["test_loss"] < 0.5 * h_drop.rows[-1]["test_loss"], (
+        h_stale.rows[-1], h_drop.rows[-1])
+
+
+def test_ledger_roundtrip_faults_json(tmp_path, devices):
+    # --faults-json export == the in-History ledger, row for row,
+    # link-fault and churn rows included (History.faults_to_json is
+    # exactly what the CLI flag calls).
+    from dopt.engine import GossipTrainer
+    from dopt.utils.metrics import History
+
+    fc = FaultConfig(crash=0.2, msg_drop=0.25, msg_delay=0.5,
+                     msg_delay_max=2, churn=0.15, churn_span=2)
+    tr = GossipTrainer(_gossip_cfg(fc, lr=0.05))
+    h = tr.run(rounds=5)
+    assert h.faults, "cocktail produced no ledger rows"
+    kinds = set(r["kind"] for r in h.faults)
+    assert {"msg_drop", "msg_delay", "churn"} <= kinds, kinds
+    path = tmp_path / "ledger.json"
+    h.faults_to_json(path)
+    reloaded = History.faults_from_json(path)
+    assert reloaded == h.faults
+    for row in reloaded:
+        assert set(row) == {"round", "worker", "kind", "action"}
+        assert row["kind"] in KINDS
+    with pytest.raises(ValueError, match="fault-ledger"):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"not": "a ledger"}))
+        History.faults_from_json(bad)
+
+
+def test_link_mode_validation(devices):
+    from dopt.config import RobustConfig
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.2)
+    with pytest.raises(ValueError, match="single-sweep"):
+        GossipTrainer(_gossip_cfg(fc, algorithm="fedlcon", eps=2))
+    with pytest.raises(ValueError, match="comm_dtype"):
+        GossipTrainer(_gossip_cfg(fc, comm_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="do not compose"):
+        GossipTrainer(ExperimentConfig(
+            name="t", seed=1, data=_LDATA, model=_LMODEL,
+            optim=OptimizerConfig(lr=0.1),
+            gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                                mode="metropolis"),
+            faults=fc, robust=RobustConfig(clip_radius=1.0)))
+    with pytest.raises(ValueError, match="unknown gossip correction"):
+        GossipTrainer(_gossip_cfg(None, correction="psum"))
+    with pytest.raises(ValueError, match="msg_drop"):
+        FaultPlan(4, FaultConfig(msg_drop=1.0), seed=0)
+
+
+def test_staleness_validation(devices):
+    from dopt.config import RobustConfig
+    from dopt.engine import FederatedTrainer
+
+    def cfg(faults=None, robust=None, **fkw):
+        f = dict(algorithm="fedavg", frac=0.5, rounds=2, local_ep=1,
+                 local_bs=16)
+        f.update(fkw)
+        return ExperimentConfig(name="t", seed=1, data=_LDATA,
+                                model=_LMODEL,
+                                optim=OptimizerConfig(lr=0.1),
+                                federated=FederatedConfig(**f),
+                                faults=faults, robust=robust)
+
+    with pytest.raises(ValueError, match="stateless-client"):
+        FederatedTrainer(cfg(algorithm="scaffold", staleness_max=2))
+    with pytest.raises(ValueError, match="weighted mean"):
+        FederatedTrainer(cfg(
+            staleness_max=2,
+            robust=RobustConfig(aggregator="trimmed_mean")))
+    with pytest.raises(ValueError, match="staleness_decay"):
+        FederatedTrainer(cfg(staleness_max=2, staleness_decay=0.0))
+    # inert staleness (nothing produces late updates) keeps the exact
+    # clean program: bit-identical History to no staleness at all
+    from dopt.engine import FederatedTrainer as FT
+
+    h0 = FT(cfg()).run(rounds=2)
+    h1 = FT(cfg(staleness_max=3)).run(rounds=2)
+    assert h0.rows == h1.rows
+
+
+# ---------------------------------------------------------------------------
+# Heavyweight end-to-end (full cocktail) — outside the tier-1 budget
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ["gossip", "federated"])
+def test_cocktail_resume_bit_exact(engine, tmp_path, devices):
+    # Full degraded-network cocktail, killed at round 2 and resumed:
+    # History rows AND fault ledger bit-identical to the continuous
+    # run (push-sum mass, staleness buffers and link history all ride
+    # the checkpoint).
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    if engine == "gossip":
+        fc = FaultConfig(crash=0.1, msg_drop=0.2, msg_delay=0.3,
+                         msg_delay_max=2, churn=0.1, churn_span=2)
+
+        def mk():
+            return GossipTrainer(_gossip_cfg(fc, lr=0.1,
+                                             correction="push_sum"))
+    else:
+        fc = FaultConfig(crash=0.1, straggle=0.5, straggle_frac=0.5,
+                         straggler_policy="drop", msg_drop=0.1,
+                         msg_delay=0.3, msg_delay_max=2, churn=0.1,
+                         churn_span=2)
+
+        def mk():
+            return FederatedTrainer(ExperimentConfig(
+                name="t", seed=7, data=_LDATA, model=_LMODEL,
+                optim=OptimizerConfig(lr=0.1, momentum=0.5),
+                federated=FederatedConfig(algorithm="fedavg", frac=0.5,
+                                          rounds=4, local_ep=1,
+                                          local_bs=16, staleness_max=2),
+                faults=fc))
+
+    path = os.fspath(tmp_path / engine)
+    hc = mk().run(rounds=4)
+    part = mk()
+    part.run(rounds=2, checkpoint_every=2, checkpoint_path=path)
+    res = mk()
+    res.restore(path)
+    assert res.round == 2
+    hr = res.run(rounds=2)
+    assert hr.rows == hc.rows
+    assert hr.faults == hc.faults
+
+
+@pytest.mark.slow
+def test_gossip_churn_blocked_matches_per_round(devices):
+    # Churn without link faults rides the ordinary consensus path, so
+    # fused-block execution must stay bit-identical to per-round.
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(churn=0.2, churn_span=2, crash=0.1)
+    ha = GossipTrainer(_gossip_cfg(fc, lr=0.1)).run(rounds=4, block=1)
+    hb = GossipTrainer(_gossip_cfg(fc, lr=0.1)).run(rounds=4, block=4)
+    assert ha.rows == hb.rows
+    assert ha.faults == hb.faults
+    assert any(r["kind"] == "churn" for r in ha.faults)
+
+
+@pytest.mark.slow
+def test_chaos_soak_smoke(tmp_path):
+    # The shipped harness end-to-end: convergence + ledger + checkpoint
+    # invariants under the randomized cocktail, both engines.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "chaos_soak.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--rounds", "4", "--seed", "0",
+                     "--ckpt-dir", os.fspath(tmp_path)]) == 0
+
+
+@pytest.mark.slow
+def test_cli_faults_json_roundtrip(tmp_path, devices):
+    # The real CLI flag: --faults-json writes a ledger a reconstructed
+    # identical run reproduces row-for-row (stateless draws).
+    from dopt.run import main
+    from dopt.utils.metrics import History
+
+    out = tmp_path / "ledger.json"
+    rc = main(["--preset", "baseline1-lossy", "--rounds", "2",
+               "--num-users", "4", "--synthetic-scale", "0.005",
+               "--faults-json", os.fspath(out)])
+    assert rc == 0 and out.exists()
+    exported = History.faults_from_json(out)
+    assert exported and all(r["kind"] in KINDS for r in exported)
+    # reconstruct the CLI's exact config and rerun: identical ledger
+    import dataclasses as dc
+
+    from dopt.engine import GossipTrainer
+    from dopt.presets import get_preset
+
+    cfg = get_preset("baseline1-lossy")
+    cfg = cfg.replace(data=dc.replace(
+        cfg.data, num_users=4,
+        synthetic_train_size=max(int(cfg.data.synthetic_train_size * 0.005),
+                                 4 * 8),
+        synthetic_test_size=max(int(cfg.data.synthetic_test_size * 0.005),
+                                64)))
+    h = GossipTrainer(cfg).run(rounds=2)
+    assert h.faults == exported
