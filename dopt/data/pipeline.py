@@ -174,7 +174,10 @@ def sharded_eval_batches(
         r = np.arange(i, n, workers)
         idx[i, :len(r)] = r
         wt[i, :len(r)] = 1.0
-        if len(r) < l:
+        if 0 < len(r) < l:
+            # Wraparound padding from the shard's own rows; a worker
+            # with NO shard rows at all (workers > n) keeps the zero
+            # indices at weight 0 — valid gathers, zero contribution.
             idx[i, len(r):] = r[:l - len(r)]
     bs = min(batch_size, l)
     steps = -(-l // bs)

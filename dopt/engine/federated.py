@@ -664,7 +664,7 @@ class FederatedTrainer:
         def compact_round_fn(theta, params, mom, duals, c_global, sel,
                              limits_sel, idx_sel, bw_sel, train_x, train_y,
                              ex, ey, ew, tidx, tweight, vidx, vw,
-                             cmask=None):
+                             cmask=None, valid=None):
             """Compact-sampling fast path: only the m = len(sel) sampled
             workers' lanes are trained ([m, ...] gather → local update →
             scatter-back), instead of all N lanes computing and the mask
@@ -700,6 +700,19 @@ class FederatedTrainer:
             # exact pre-robust expressions when nothing was screened, so
             # clean compact runs stay bit-identical.
             fin = finite_lane_mask(p_t)
+            if valid is not None:
+                # Fixed-width fault lanes (the sorted-position-weighting
+                # idea from dopt.robust applied to sampling): the m lane
+                # slots are always filled — survivors first, then
+                # padding ids whose results are discarded — and the
+                # round's survivor count is DATA in ``valid``, not a
+                # shape.  One compiled program serves every faulted
+                # round, which is what makes compact+faults fuse into
+                # blocks (and stop retracing per survivor count).
+                # Folding validity into ``fin`` gives padding lanes the
+                # screened-lane treatment everywhere below: excluded
+                # from the aggregate, scatter-back is a self-write.
+                fin = fin * valid
             all_fin = fin.min() >= 1.0
             sub_new_g = _where_mask(fin, sub_new, duals_sel)
             if algorithm in ("scaffold", "fedadmm"):
@@ -737,56 +750,186 @@ class FederatedTrainer:
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
 
-        def make_block_fn(one_round):
+        def make_block_fn(one_round, with_valid=False):
             """k rounds fused into one lax.scan dispatch (jit retraces
             per distinct k).  Each iteration is one full reference round
             — sampled-client theta load, local epochs, masked average,
             global + per-client train eval — so history rows are
             identical to the per-round path's.  Under corrupt faults the
             per-round corrupt masks ride the scan as one more stacked
-            input; the clean signature is unchanged."""
+            input; ``with_valid`` additionally threads the fixed-width
+            compact path's per-round validity masks.  The clean
+            signature (and compiled program) is unchanged."""
 
-            if has_corrupt:
-                def block_fn(theta, params, mom, duals, c_global, gates,
-                             limits, cmasks, idxs, bws, train_x, train_y,
-                             ex, ey, ew, tidx, tweight, vidx, vw):
-                    def body(carry, xs):
-                        th, p, m, d, c = carry
-                        gate, lim, cm, idx, bw = xs
-                        th, p, m, d, c, packed = one_round(
-                            th, p, m, d, c, gate, lim, idx, bw,
-                            train_x, train_y, ex, ey, ew, tidx, tweight,
-                            vidx, vw, cmask=cm)
-                        return (th, p, m, d, c), packed
-
-                    carry, packed = jax.lax.scan(
-                        body, (theta, params, mom, duals, c_global),
-                        (gates, limits, cmasks, idxs, bws))
-                    return (*carry, packed)
-
-                return jax.jit(block_fn, donate_argnums=(1, 2, 3))
-
-            def block_fn(theta, params, mom, duals, c_global, gates, limits,
-                         idxs, bws, train_x, train_y, ex, ey, ew, tidx,
-                         tweight, vidx, vw):
+            def block_fn(theta, params, mom, duals, c_global, gates,
+                         limits, idxs, bws, train_x, train_y, ex, ey, ew,
+                         tidx, tweight, vidx, vw, cmasks=None,
+                         valids=None):
                 def body(carry, xs):
                     th, p, m, d, c = carry
-                    gate, lim, idx, bw = xs
+                    xs = list(xs)
+                    gate, lim = xs[0], xs[1]
+                    i = 2
+                    kw = {}
+                    if has_corrupt:
+                        kw["cmask"] = xs[i]
+                        i += 1
+                    if with_valid:
+                        kw["valid"] = xs[i]
+                        i += 1
+                    idx, bw = xs[i], xs[i + 1]
                     th, p, m, d, c, packed = one_round(
                         th, p, m, d, c, gate, lim, idx, bw,
                         train_x, train_y, ex, ey, ew, tidx, tweight,
-                        vidx, vw)
+                        vidx, vw, **kw)
                     return (th, p, m, d, c), packed
 
+                xs = [gates, limits]
+                if has_corrupt:
+                    xs.append(cmasks)
+                if with_valid:
+                    xs.append(valids)
+                xs += [idxs, bws]
                 carry, packed = jax.lax.scan(
                     body, (theta, params, mom, duals, c_global),
-                    (gates, limits, idxs, bws))
+                    tuple(xs))
                 return (*carry, packed)
 
             return jax.jit(block_fn, donate_argnums=(1, 2, 3))
 
         self._block_fn = make_block_fn(round_fn)
         self._compact_block_fn = make_block_fn(compact_round_fn)
+        self._compact_fault_block_fn = make_block_fn(compact_round_fn,
+                                                     with_valid=True)
+
+        # ---- fused chaos block (quarantine and/or staleness) ----------
+        # The modes that used to force per-round execution did so
+        # because their round-to-round state lived on the HOST: the
+        # quarantine streaks fed next round's participation, and the
+        # staleness buffer's capture/admit schedule was host
+        # bookkeeping.  Here that state is scan CARRY (int32/f32
+        # vectors + the one-slot [W, ...] buffer) and the round's
+        # PARTICIPATION itself is computed on device from the
+        # pre-drawn candidate list: the elif-chain of
+        # ``_round_participation`` becomes branch masks, the
+        # keep-first-m survivor cut a cumsum over draw order, and
+        # admission weights a ``decay_pow`` table gather — all data,
+        # no shapes.  The host replays the identical integer logic
+        # post-fetch for the ledger (same rows, same order).
+        q_on, q_after = self._quarantine_on, self._quarantine_after
+        q_rounds = self._quarantine_rounds
+        drop_policy_s = (cfg.faults is not None
+                         and cfg.faults.straggler_policy == "drop")
+        s_max = self._staleness_max
+        # f32(f64 decay**d) per d — the exact value the host admission
+        # path produces via np.float32(self._stale_weight[i]).
+        self._decay_pow = np.asarray(
+            [np.float32(float(f.staleness_decay) ** d)
+             for d in range(max(s_max, 1) + 1)], np.float32)
+        decay_pow = jnp.asarray(self._decay_pow)
+
+        def device_participation(t, chosen, quar, away, crashed, unreach,
+                                 straggler, up_drop, up_delay, late_d,
+                                 m_cut):
+            """Round t's participation decisions as device math, in the
+            exact priority order of the host elif-chain (quarantine >
+            churn > crash > partition > straggler-deadline > uplink
+            drop > uplink delay > survivor).  Returns (mask, cap,
+            d_vec): the [W] aggregating-survivor mask, the [W] capture
+            mask (has_stale), and the capture lateness per worker."""
+            q_c = quar[chosen]
+            excl = (q_c | (away[chosen] > 0) | (crashed[chosen] > 0)
+                    | (unreach[chosen] > 0))
+            sg_c = (straggler[chosen] > 0) & ~excl
+            strag_branch = sg_c if drop_policy_s else jnp.zeros_like(q_c)
+            after_strag = excl | strag_branch
+            ud_c = (up_drop[chosen] > 0) & ~after_strag
+            dl = up_delay[chosen]
+            dl_c = (dl > 0) & ~after_strag & ~(up_drop[chosen] > 0)
+            survivor_ok = ~(after_strag | ud_c | dl_c)
+            rank = jnp.cumsum(survivor_ok.astype(jnp.int32))
+            sel_c = survivor_ok & (rank <= m_cut)
+            mask = jnp.zeros(w, jnp.float32).at[chosen].add(
+                sel_c.astype(jnp.float32))
+            if has_stale:
+                cap_c = strag_branch | (dl_c & (dl <= s_max))
+                d_c = jnp.where(strag_branch,
+                                jnp.minimum(late_d[chosen], s_max),
+                                jnp.minimum(dl, s_max))
+                cap = jnp.zeros(w, jnp.float32).at[chosen].add(
+                    jnp.where(cap_c, 1.0, 0.0))
+                d_vec = jnp.zeros(w, jnp.int32).at[chosen].add(
+                    jnp.where(cap_c, d_c, 0))
+            else:
+                cap = jnp.zeros(w, jnp.float32)
+                d_vec = jnp.zeros(w, jnp.int32)
+            return mask, cap, d_vec
+
+        def chaos_block_fn(theta, params, mom, duals, c_global, streak,
+                           until, st_admit, st_w, stale_p, m_cut, ts,
+                           chosen, away, crashed, unreach, straggler,
+                           up_drop, up_delay, late_d, limits,
+                           corrupt_raw, idxs, bws, train_x, train_y, ex,
+                           ey, ew, tidx, tweight, vidx, vw):
+            def body(carry, xs):
+                th, p, mo, d, c, stk, unt, sta, stw, sp = carry
+                (t_t, ch, aw, cr, un, sg, ud, dl, ld, lim, craw, idx,
+                 bw) = xs
+                # Round start: readmit expired sentences (mirrors
+                # _round_participation), then decide who plays.
+                expired = (unt != 0) & (t_t >= unt)
+                unt = jnp.where(expired, 0, unt)
+                stk = jnp.where(expired, 0, stk)
+                quar = unt > t_t
+                kw = {}
+                if has_stale:
+                    due = (sta == t_t) & (stw > 0)
+                    admit_w = jnp.where(due & ~quar, stw, 0.0)
+                    sta = jnp.where(due, 0, sta)
+                    stw = jnp.where(due, 0.0, stw)
+                mask, cap, d_vec = device_participation(
+                    t_t, ch, quar, aw, cr, un, sg, ud, dl, ld, m_cut)
+                if has_stale:
+                    captured = cap > 0
+                    sta = jnp.where(captured, t_t + d_vec, sta)
+                    stw = jnp.where(captured, decay_pow[d_vec], stw)
+                    kw.update(load_mask=jnp.clip(mask + cap, 0.0, 1.0),
+                              stale_p=sp, admit_w=admit_w, capture=cap)
+                if has_corrupt:
+                    kw["cmask"] = craw * jnp.clip(mask + cap, 0.0, 1.0)
+                out = round_fn(th, p, mo, d, c, mask, lim, idx, bw,
+                               train_x, train_y, ex, ey, ew, tidx,
+                               tweight, vidx, vw, **kw)
+                if has_stale:
+                    th, p, mo, d, c, sp, packed = out
+                else:
+                    th, p, mo, d, c, packed = out
+                # Screen feedback over the round's sampled lanes — the
+                # jnp mirror of _apply_screen_feedback (packed layout:
+                # the [W] screened flags start at offset 5).
+                scr = packed[5:5 + w]
+                part = mask > 0
+                flagged = part & (scr > 0.5)
+                stk2 = jnp.where(flagged, stk + 1,
+                                 jnp.where(part, 0, stk))
+                if q_on:
+                    trigger = flagged & (stk2 >= q_after)
+                    unt = jnp.where(trigger, t_t + 1 + q_rounds, unt)
+                    stk = jnp.where(trigger, 0, stk2)
+                else:
+                    stk = stk2
+                return (th, p, mo, d, c, stk, unt, sta, stw, sp), packed
+
+            carry, packed = jax.lax.scan(
+                body,
+                (theta, params, mom, duals, c_global, streak, until,
+                 st_admit, st_w, stale_p),
+                (ts, chosen, away, crashed, unreach, straggler, up_drop,
+                 up_delay, late_d, limits, corrupt_raw, idxs, bws))
+            return (*carry, packed)
+
+        self._chaos_block_fn = jax.jit(chaos_block_fn,
+                                       donate_argnums=(1, 2, 3))
         self._global_eval = jax.jit(global_eval)
         self._sample_rng = host_rng(cfg.seed, 314159)
 
@@ -804,8 +947,45 @@ class FederatedTrainer:
         mask[self._sample_indices(frac)] = 1.0
         return mask
 
+    def _participation_static(self, t: int, frac: float) -> dict:
+        """Carry-INDEPENDENT per-round participation inputs for the
+        fused chaos block: the candidate draw (the only stateful step —
+        same RNG call, same stream as the per-round path) plus the
+        round's stateless fault vectors, as [W] device-ready arrays.
+        Touches NO quarantine/staleness state and emits NO ledger rows;
+        the blocked loop replays ``_round_participation(t, frac,
+        chosen=...)`` post-fetch once the screened flags are back."""
+        w = self.num_workers
+        m = max(int(frac * w), 1)
+        c = self.faults.cfg
+        n_draw = m
+        if self.faults.active and c.over_select > 0.0:
+            n_draw = min(int(np.ceil(m * (1.0 + c.over_select))), w)
+        chosen = self._sample_rng.choice(
+            w, n_draw, replace=False).astype(np.int32)
+        rf = self.faults.for_round(t)
+        away = self.faults.away_for_round(t)
+        up_drop, up_delay = self.faults.uplink_for_round(t)
+        unreach = (np.zeros(w, bool) if rf.partition is None
+                   else rf.partition != 0)
+        late_d = (self.faults.straggler_lateness(t, self._staleness_max)
+                  if self._has_stale else np.zeros(w, np.int32))
+        corrupt = (rf.corrupt
+                   if self._has_corrupt and rf.corrupt is not None
+                   else np.zeros(w, bool))
+        return dict(
+            chosen=chosen, away=away.astype(np.float32),
+            crashed=rf.crashed.astype(np.float32),
+            unreach=unreach.astype(np.float32),
+            straggler=rf.straggler.astype(np.float32),
+            up_drop=up_drop.astype(np.float32),
+            up_delay=up_delay.astype(np.int32),
+            late_d=late_d.astype(np.int32),
+            limits=FaultPlan.limits_for(rf, self._straggle_units),
+            corrupt=corrupt.astype(np.float32))
+
     def _round_participation(
-            self, t: int, frac: float
+            self, t: int, frac: float, chosen: np.ndarray | None = None
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, list,
                np.ndarray, np.ndarray]:
         """Sample round t's clients and apply its faults: returns
@@ -874,9 +1054,12 @@ class FederatedTrainer:
         # over-selection surplus must be released uniformly (sorting
         # first would systematically release the highest worker ids,
         # biasing participation toward low ids); the final survivor
-        # set is sorted on return.
-        chosen = self._sample_rng.choice(
-            w, n_draw, replace=False).astype(np.int32)
+        # set is sorted on return.  ``chosen`` can be supplied by the
+        # fused-chaos blocked loop, whose plan phase already drew it
+        # (``_participation_static``) — the replay must not re-draw.
+        if chosen is None:
+            chosen = self._sample_rng.choice(
+                w, n_draw, replace=False).astype(np.int32)
         rf = self.faults.for_round(t)
         limits = FaultPlan.limits_for(rf, self._straggle_units)
         cmask = np.zeros(w, np.float32)
@@ -1051,19 +1234,44 @@ class FederatedTrainer:
             return f.compact
         return True
 
+    def _fixed_width_sel(self, sel: np.ndarray,
+                         frac: float) -> tuple[np.ndarray, np.ndarray]:
+        """Pad a round's survivor set to the static m = max(frac·W, 1)
+        lane count: survivors first, then deterministic padding ids
+        (the lowest worker ids not already selected), with a 0/1
+        validity prefix mask.  Padding lanes train and are discarded by
+        the validity mask — survivor counts become DATA, so every
+        faulted compact round shares one compiled program and stacks
+        into fused blocks."""
+        w = self.num_workers
+        m = max(int(frac * w), 1)
+        pad = np.setdiff1d(np.arange(w, dtype=np.int32),
+                           sel)[:m - len(sel)]
+        sel_full = np.concatenate([sel, pad]).astype(np.int32)
+        valid = np.zeros(m, np.float32)
+        valid[:len(sel)] = 1.0
+        return sel_full, valid
+
     def _run_blocked(self, frac: float, rounds: int, block: int,
                      checkpoint_every: int = 0,
                      checkpoint_path=None) -> History:
         """Run ``rounds`` rounds in fused blocks of up to ``block``.
         Periodic auto-checkpoints land at block boundaries (the state
-        only exists on the host there).  Faulted runs reach here only on
-        the full-width path (``run`` falls back to per-round execution
-        for compact + faults: survivor counts vary per round, and the
-        compact block stacks fixed-width lanes)."""
+        only exists on the host there).  Compact + faults runs
+        fixed-width validity-masked lanes; quarantine / staleness runs
+        route to ``_run_blocked_chaos`` (their round-to-round state is
+        scan carry)."""
         from dopt.parallel.mesh import worker_axes
 
+        if self._quarantine_on or self._has_stale:
+            # Both force the full-width path (run() keeps
+            # compact+quarantine per-round; staleness rejects compact).
+            return self._run_blocked_chaos(
+                frac, rounds, block, checkpoint_every=checkpoint_every,
+                checkpoint_path=checkpoint_path)
         cfg, f = self.cfg, self.cfg.federated
         compact = self._use_compact(frac)
+        fixed_c = compact and self.faults.active
         block_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
         )
@@ -1078,26 +1286,36 @@ class FederatedTrainer:
                 parts = [self._round_participation(t, frac) for t in ts]
                 sels = [p[0] for p in parts]
                 frows = [p[3] for p in parts]
+                if fixed_c:
+                    fw = [self._fixed_width_sel(sel, frac) for sel in sels]
+                    lane_sels = [x[0] for x in fw]
+                    valids = jnp.asarray(np.stack([x[1] for x in fw]))
+                else:
+                    lane_sels = sels
+                    valids = None
                 plans = [
                     make_batch_plan(
                         self._plan_matrix_for_round(t), batch_size=f.local_bs,
                         local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
                         impl=cfg.data.plan_impl,
-                        workers=sel if compact else None,
+                        workers=lane_sel if compact else None,
                     )
-                    for t, sel in zip(ts, sels)
+                    for t, lane_sel in zip(ts, lane_sels)
                 ]
                 if self._has_corrupt:
-                    # Only the full-width path reaches here with faults
-                    # active (run() forces per-round for compact+faults,
-                    # where survivor counts vary), so the [k, W] corrupt
-                    # masks stack directly.
-                    assert not compact
-                    cms = jnp.asarray(np.stack([p[2] for p in parts]))
+                    # [k, lanes] corrupt masks: full-width rounds stack
+                    # the [W] masks directly, fixed-width compact rounds
+                    # gather their lane slice (padding ids carry no lie
+                    # — the host only flags survivors/captured).
+                    cms = jnp.asarray(np.stack(
+                        [p[2][ls] for p, ls in zip(parts, lane_sels)]
+                        if compact else [p[2] for p in parts]))
+                else:
+                    cms = None
                 if compact:
-                    gates = jnp.asarray(np.stack(sels))
-                    limits = jnp.asarray(
-                        np.stack([p[1][sel] for sel, p in zip(sels, parts)]))
+                    gates = jnp.asarray(np.stack(lane_sels))
+                    limits = jnp.asarray(np.stack(
+                        [p[1][ls] for ls, p in zip(lane_sels, parts)]))
                     idx = jnp.asarray(np.stack([p.idx for p in plans]))
                     bw = jnp.asarray(np.stack([p.weight for p in plans]))
                 else:
@@ -1112,28 +1330,33 @@ class FederatedTrainer:
                                         block_sharding)
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
-            fn = self._compact_block_fn if compact else self._block_fn
-            args = [gates, limits]
+            fn = (self._compact_fault_block_fn if fixed_c
+                  else self._compact_block_fn if compact
+                  else self._block_fn)
+            step_kw = {}
             if self._has_corrupt:
-                args.append(cms)
+                step_kw["cmasks"] = cms
+            if fixed_c:
+                step_kw["valids"] = valids
             (self.theta, self.params, self.momentum, new_duals, new_c,
              packed) = self.timers.measure(
                 "round_step", fn,
                 self.theta, self.params, self.momentum, duals_in, c_in,
-                *args, idx, bw, self._train_x, self._train_y,
+                gates, limits, idx, bw, self._train_x, self._train_y,
                 *self._eval,
                 self._train_eval_idx, self._train_eval_w, *self._val,
+                **step_kw,
             )
             if self.duals is not None:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
             packed = np.asarray(packed)  # ONE device→host fetch per block
-            lanes = len(sels[0]) if compact else self.num_workers
+            lanes = len(lane_sels[0]) if compact else self.num_workers
             for j, t in enumerate(ts):
                 ll, acc, loss_sum, t_loss, t_acc, scr, _, em = \
                     self._unpack_host_metrics(packed[j], lanes)
-                flags = scr if compact else scr[sels[j]]
+                flags = (scr[:len(sels[j])] if compact else scr[sels[j]])
                 self._apply_screen_feedback(t, sels[j], flags, frows[j])
                 self.history.faults.extend(frows[j])
                 self.history.append(
@@ -1145,10 +1368,138 @@ class FederatedTrainer:
                     local_loss=ll,
                 )
                 if self._holdout:
-                    if not compact:
-                        em = {k_: v[sels[j]] for k_, v in em.items()}
+                    em = ({k_: v[:len(sels[j])] for k_, v in em.items()}
+                          if compact
+                          else {k_: v[sels[j]] for k_, v in em.items()})
                     self._append_client_rows(t, em, sels[j])
                 self.round += 1
+            done += k
+            if next_ckpt is not None and self.round >= next_ckpt:
+                self.save(checkpoint_path)
+                next_ckpt = (self.round // checkpoint_every + 1) \
+                    * checkpoint_every
+        self.total_time = time.time() - t0
+        return self.history
+
+    def _run_blocked_chaos(self, frac: float, rounds: int, block: int,
+                           checkpoint_every: int = 0,
+                           checkpoint_path=None) -> History:
+        """Fused blocked execution for the modes whose round-to-round
+        state used to pin them per-round: quarantine (streak/until) and
+        staleness-aware aggregation (admission schedule + the one-slot
+        late-update buffer) ride the scan CARRY, participation is
+        decided on device from the pre-drawn candidate lists, and the
+        host replays the identical integer logic post-fetch so the
+        ledger rows (and their order) are bit-identical to per-round
+        execution."""
+        from dopt.parallel.mesh import worker_axes
+
+        cfg, f = self.cfg, self.cfg.federated
+        w = self.num_workers
+        m = max(int(frac * w), 1)
+        block_sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
+        )
+        t0 = time.time()
+        done = 0
+        next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
+            if checkpoint_every else None
+        while done < rounds:
+            k = min(block, rounds - done)
+            ts = [self.round + j for j in range(k)]
+            with self.timers.phase("host_batch_plan"):
+                stat = [self._participation_static(t, frac) for t in ts]
+                chosen = np.stack([s["chosen"] for s in stat])
+                stacks = {key: jnp.asarray(np.stack([s[key] for s in stat]))
+                          for key in ("away", "crashed", "unreach",
+                                      "straggler", "up_drop", "up_delay",
+                                      "late_d", "limits", "corrupt")}
+                plans = [
+                    make_batch_plan(
+                        self._plan_matrix_for_round(t), batch_size=f.local_bs,
+                        local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
+                        impl=cfg.data.plan_impl)
+                    for t in ts
+                ]
+                idx = jax.device_put(np.stack([p.idx for p in plans]),
+                                     block_sharding)
+                bw = jax.device_put(np.stack([p.weight for p in plans]),
+                                    block_sharding)
+            duals_in = self.duals if self.duals is not None else {}
+            c_in = self.c_global if self.c_global is not None else {}
+            sp_in = self._stale_p if self._has_stale else {}
+            (self.theta, self.params, self.momentum, new_duals, new_c,
+             dev_stk, dev_unt, dev_sta, dev_stw, new_sp,
+             packed) = self.timers.measure(
+                "round_step", self._chaos_block_fn,
+                self.theta, self.params, self.momentum, duals_in, c_in,
+                jnp.asarray(self._screen_streak.astype(np.int32)),
+                jnp.asarray(self._quarantine_until.astype(np.int32)),
+                jnp.asarray(self._stale_admit_round.astype(np.int32)),
+                jnp.asarray(self._stale_weight.astype(np.float32)),
+                sp_in, jnp.asarray(m, jnp.int32),
+                jnp.asarray(ts, jnp.int32), jnp.asarray(chosen),
+                stacks["away"], stacks["crashed"], stacks["unreach"],
+                stacks["straggler"], stacks["up_drop"],
+                stacks["up_delay"], stacks["late_d"], stacks["limits"],
+                stacks["corrupt"], idx, bw, self._train_x, self._train_y,
+                *self._eval,
+                self._train_eval_idx, self._train_eval_w, *self._val,
+            )
+            if self.duals is not None:
+                self.duals = new_duals
+            if self.c_global is not None:
+                self.c_global = new_c
+            if self._has_stale:
+                self._stale_p = new_sp
+            packed = np.asarray(packed)  # ONE device→host fetch per block
+            for j, t in enumerate(ts):
+                # Post-fetch ledger replay: host quarantine/staleness
+                # mirrors are current through round t-1's flags, so
+                # this regenerates exactly the per-round path's rows —
+                # and the same candidate draw is reused, not re-drawn.
+                (sel, _lim, _cm, frows, _cap,
+                 _admit) = self._round_participation(t, frac,
+                                                     chosen=chosen[j])
+                ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em = \
+                    self._unpack_host_metrics(packed[j], w)
+                self._apply_screen_feedback(t, sel, scr[sel], frows)
+                if self._has_stale and sscr is not None:
+                    for i in np.nonzero(sscr > 0.5)[0]:
+                        frows.append({
+                            "round": int(t), "worker": int(i),
+                            "kind": "staleness",
+                            "action": "screened_nonfinite_on_admission"})
+                self.history.faults.extend(frows)
+                self.history.append(
+                    round=t,
+                    test_acc=acc,
+                    test_loss=loss_sum,  # P1 summed-loss flavour
+                    train_loss=t_loss,
+                    train_acc=t_acc,
+                    local_loss=ll,
+                )
+                if self._holdout:
+                    em = {k_: v[sel] for k_, v in em.items()}
+                    self._append_client_rows(t, em, sel)
+                self.round += 1
+            # The host replay and the device carry apply the same rule
+            # to the same flags; drift is a bug, surfaced loudly.
+            ok = (np.array_equal(np.asarray(dev_stk),
+                                 self._screen_streak.astype(np.int32))
+                  and np.array_equal(np.asarray(dev_unt),
+                                     self._quarantine_until.astype(np.int32)))
+            if self._has_stale:
+                ok = ok and np.array_equal(
+                    np.asarray(dev_sta),
+                    self._stale_admit_round.astype(np.int32))
+                ok = ok and np.array_equal(
+                    np.asarray(dev_stw),
+                    self._stale_weight.astype(np.float32))
+            if not ok:
+                raise RuntimeError(
+                    "fused-chaos host replay diverged from the device "
+                    "scan carry")
             done += k
             if next_ckpt is not None and self.round >= next_ckpt:
                 self.save(checkpoint_path)
@@ -1176,32 +1527,38 @@ class FederatedTrainer:
         block = f.block_rounds if block is None else block
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
-        if (block > 1
-                and not (self.faults.active and self._use_compact(frac))
-                and not self._quarantine_on
-                and not self._has_stale):
-            # Compact + faults stays per-round: survivor counts vary
-            # round to round and the compact block stacks fixed-width
-            # lane sets.  Quarantine stays per-round too: the next
-            # round's participation depends on THIS round's device-side
-            # screen flags, which a fused block only surfaces at its
-            # end.  Staleness-aware aggregation stays per-round: the
-            # host schedules buffer captures/admissions round by round.
+        if block > 1 and not (self._quarantine_on
+                              and self._use_compact(frac)):
+            # Every mode but compact+quarantine is blocked-eligible:
+            # compact+faults runs fixed-width validity-masked lanes
+            # (survivor counts are data, not shapes), and quarantine /
+            # staleness-aware runs fuse through the chaos scan whose
+            # carry holds the streaks, the admission schedule and the
+            # one-slot late-update buffer.  Compact+quarantine stays
+            # per-round: its gather indices are host data but depend on
+            # the device-side quarantine state.
             return self._run_blocked(frac, rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
         compact = self._use_compact(frac)
+        fixed_c = compact and self.faults.active
         t0 = time.time()
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
                 (sel, limits, cmask, frows, cap,
                  admit) = self._round_participation(t, frac)
-                # The compact path needs >= 1 survivor lane; a round
-                # whose every sampled client failed degrades to one
-                # full-width step with an all-zero mask (theta and all
-                # worker state pass through unchanged).
-                use_c = compact and sel.size > 0
+                if fixed_c:
+                    # Fixed-width compact fault lanes: survivors first,
+                    # padding ids after, validity as data — one
+                    # compiled program for every survivor count (no
+                    # per-count retrace), identical semantics to the
+                    # old variable-width path up to float summation
+                    # order.
+                    sel_lanes, valid_np = self._fixed_width_sel(sel, frac)
+                else:
+                    sel_lanes, valid_np = sel, None
+                use_c = compact and sel_lanes.size > 0
                 # Compact path: plan only the m sampled workers' rows —
                 # host cost O(m), and the RNG is keyed by true worker id
                 # so the plans are bit-identical to the full plan's rows.
@@ -1209,12 +1566,12 @@ class FederatedTrainer:
                     self._plan_matrix_for_round(t), batch_size=f.local_bs,
                     local_ep=f.local_ep,
                     seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
-                    workers=sel if use_c else None,
+                    workers=sel_lanes if use_c else None,
                 )
                 if use_c:
                     idx = jnp.asarray(plan.idx)
                     bweight = jnp.asarray(plan.weight)
-                    lim_dev = jnp.asarray(limits[sel])
+                    lim_dev = jnp.asarray(limits[sel_lanes])
                 else:
                     mask = np.zeros(self.num_workers, np.float32)
                     mask[sel] = 1.0
@@ -1224,9 +1581,12 @@ class FederatedTrainer:
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
             step_fn = self._compact_fn if use_c else self._round_fn
-            gate = jnp.asarray(sel) if use_c else jnp.asarray(mask)
-            step_kw = ({"cmask": jnp.asarray(cmask[sel] if use_c else cmask)}
-                       if self._has_corrupt else {})
+            gate = jnp.asarray(sel_lanes) if use_c else jnp.asarray(mask)
+            step_kw = ({"cmask": jnp.asarray(
+                cmask[sel_lanes] if use_c else cmask)}
+                if self._has_corrupt else {})
+            if fixed_c and use_c:
+                step_kw["valid"] = jnp.asarray(valid_np)
             if self._has_stale:
                 step_kw.update(
                     load_mask=jnp.asarray(np.clip(mask + cap, 0.0, 1.0)),
@@ -1250,11 +1610,13 @@ class FederatedTrainer:
                 self.duals = new_duals
             if self.c_global is not None:
                 self.c_global = new_c
-            lanes = len(sel) if use_c else self.num_workers
+            lanes = len(sel_lanes) if use_c else self.num_workers
             ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em = \
                 self._unpack_host_metrics(
                     np.asarray(packed), lanes)  # ONE device→host fetch/round
-            flags = scr if use_c else scr[sel]
+            # Compact lanes are survivors-first: the valid prefix holds
+            # the real flags (padding lanes' flags are discarded).
+            flags = scr[:len(sel)] if use_c else scr[sel]
             self._apply_screen_feedback(t, sel, flags, frows)
             if self._has_stale and sscr is not None:
                 for i in np.nonzero(sscr > 0.5)[0]:
@@ -1271,8 +1633,8 @@ class FederatedTrainer:
                 local_loss=ll,
             )
             if self._holdout:
-                if not use_c:
-                    em = {k_: v[sel] for k_, v in em.items()}
+                em = ({k_: v[:len(sel)] for k_, v in em.items()} if use_c
+                      else {k_: v[sel] for k_, v in em.items()})
                 self._append_client_rows(t, em, sel)
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:

@@ -341,17 +341,40 @@ class GossipTrainer:
                     "plain consensus collectives; the link-fault / "
                     "push-sum path runs its own per-staleness "
                     "contractions — drop one of the two")
-            if clip_tau > 0 or self._quarantine_on:
+            if clip_tau > 0:
                 raise ValueError(
-                    "clipped gossip / quarantine do not compose with "
-                    "the lossy-link consensus path yet — run the robust "
-                    "layer and link faults in separate experiments")
+                    "clipped gossip does not compose with the lossy-link "
+                    "consensus path yet — run clip_radius and link "
+                    "faults in separate experiments")
+            # Quarantine DOES compose with link faults, via the alive
+            # machinery: a quarantined worker's edges are repaired out
+            # of the matrix before the link drops/delays apply.  The
+            # link path emits no screened flags (only finite lies reach
+            # it), so the quarantine state evolves purely by expiry —
+            # which is what keeps its plan-time inputs exact under
+            # blocked execution.
             if has_corrupt and cfg.faults.corrupt_mode in ("nan", "inf"):
                 raise ValueError(
                     "corrupt_mode='nan'/'inf' under link faults would "
                     "need byzantine_mix's poison routing, which the "
                     "per-staleness link path does not implement; use "
                     "the finite lies (scale|signflip)")
+
+        # Fused-quarantine execution (the "everything is scan carry"
+        # model): on the dense robust path the quarantine streak/until
+        # state is int32 DEVICE state riding the blocked scan as carry,
+        # the alive mask combination + matrix repair happen inside the
+        # compiled round (dopt.topology.repair_for_dropout_jnp), and
+        # the host replays the identical integer update rule post-fetch
+        # for the ledger rows — so quarantined runs are blocked-eligible
+        # with bit-identical per-round/blocked traces.  Link-mode
+        # quarantine stays host-side plan-time data: the link path
+        # screens nothing, so its quarantine state evolves by expiry
+        # alone and is exactly known when the block is planned.
+        self._fused_quar = self._quarantine_on and not self._link_mode
+        fused_quar = self._fused_quar
+        q_after = self._quarantine_after
+        q_rounds = self._quarantine_rounds
 
         # Compiled round step.
         update_impl = "pallas" if cfg.optim.fused_update else "jnp"
@@ -685,9 +708,45 @@ class GossipTrainer:
                     params = mix_once(params, w_matrix)
             return params, x_hat, screened
 
+        def effective_inputs(w_matrix, alive, quar, cmask):
+            """Fused-quarantine input adjustment, ON DEVICE (both
+            execution paths run this, which is what makes them
+            bit-identical): fold the quarantine mask into alive, mute
+            quarantined liars, and repair the matrix for the combined
+            dead set — skipping the repair division on all-alive
+            rounds, mirroring the host path's ``alive.min() < 1``
+            guard.  A no-op (python-level) without fused quarantine, so
+            every other configuration compiles the pre-change
+            program."""
+            if not fused_quar:
+                return w_matrix, alive, cmask
+            from dopt.topology import repair_for_dropout_jnp
+
+            alive = alive * (1.0 - quar)
+            if has_corrupt:
+                cmask = cmask * (1.0 - quar)
+            rep = repair_for_dropout_jnp(w_matrix, alive)
+            w_matrix = jnp.where(alive.min() >= 1.0, w_matrix, rep)
+            return w_matrix, alive, cmask
+
+        def quarantine_update(streak, until, scr, alive, t):
+            """Post-round screen feedback as int32 device math — the
+            exact jnp mirror of ``_apply_screen_feedback``: a screened
+            round extends the streak (K in a row triggers the bench), a
+            clean ALIVE round resets it."""
+            flagged = scr > 0.5
+            streak2 = jnp.where(flagged, streak + 1,
+                                jnp.where(alive > 0, 0, streak))
+            trigger = flagged & (streak2 >= q_after)
+            until = jnp.where(trigger, t + 1 + q_rounds, until)
+            streak = jnp.where(trigger, 0, streak2)
+            return streak, until
+
         def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
                      bweight, train_x, train_y, ex, ey, ew, vidx, vw,
-                     do_eval, cmask=None):
+                     do_eval, cmask=None, quar=None):
+            w_matrix, alive, cmask = effective_inputs(w_matrix, alive,
+                                                      quar, cmask)
             params, x_hat, screened = consensus_phase(
                 params, x_hat, w_matrix, alive, t, cmask)
             evalm = jax.lax.cond(
@@ -727,7 +786,7 @@ class GossipTrainer:
 
         def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
                      is_eval, train_x, train_y, ex, ey, ew, vidx, vw,
-                     cmasks=None):
+                     cmasks=None, streak=None, until=None):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -736,15 +795,32 @@ class GossipTrainer:
             minibatch gather happens inside the step scan from the
             resident train arrays; compile cost is O(1) in k.  Under
             corrupt faults the per-round corrupt masks ride the scan as
-            one more stacked input."""
+            one more stacked input; under fused quarantine the int32
+            streak/until state rides the CARRY (readmission at round
+            start, screen feedback after the round — the same order the
+            per-round host loop applies), so quarantined runs fuse
+            without surfacing flags to the host mid-block."""
 
             def body(carry, xs):
-                p, m, xh = carry
+                if fused_quar:
+                    p, m, xh, stk, unt = carry
+                else:
+                    p, m, xh = carry
+                    stk = unt = None
                 if has_corrupt:
                     w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t, cm_t = xs
                 else:
                     w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
                     cm_t = None
+                if fused_quar:
+                    # Round-start readmission (mirrors _round_inputs):
+                    # an expired sentence clears the bench + streak.
+                    expired = (unt != 0) & (t_t >= unt)
+                    unt = jnp.where(expired, 0, unt)
+                    stk = jnp.where(expired, 0, stk)
+                    quar_t = (unt > t_t).astype(jnp.float32)
+                    w_t, alive_t, cm_t = effective_inputs(w_t, alive_t,
+                                                          quar_t, cm_t)
                 p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t, cm_t)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 if use_holdout:
@@ -762,14 +838,22 @@ class GossipTrainer:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                return (p_t, m_t, xh), pack_host_metrics(tl, ta, evalm, em,
-                                                         scr)
+                packed = pack_host_metrics(tl, ta, evalm, em, scr)
+                if fused_quar:
+                    stk, unt = quarantine_update(stk, unt, scr, alive_t,
+                                                 t_t)
+                    return (p_t, m_t, xh, stk, unt), packed
+                return (p_t, m_t, xh), packed
 
             xs = [w_mats, alive, limits, ts, idx, bw, is_eval]
             if has_corrupt:
                 xs.append(cmasks)
-            (params, mom, x_hat), packed = jax.lax.scan(
-                body, (params, mom, x_hat), tuple(xs))
+            carry0 = ((params, mom, x_hat, streak, until) if fused_quar
+                      else (params, mom, x_hat))
+            carry, packed = jax.lax.scan(body, carry0, tuple(xs))
+            if fused_quar:
+                return (*carry, packed)
+            params, mom, x_hat = carry
             return params, mom, x_hat, packed
 
         self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
@@ -821,10 +905,10 @@ class GossipTrainer:
             def _tree_add(a, b):
                 return jax.tree.map(jnp.add, a, b)
 
-            def link_round_fn(params, mom, mass, buf, buf_mass, mats,
-                              alive, limits, t, idx, bweight, train_x,
-                              train_y, ex, ey, ew, vidx, vw, do_eval,
-                              cmask=None):
+            def link_round_core(params, mom, mass, buf, buf_mass, mats,
+                                alive, limits, t, idx, bweight, train_x,
+                                train_y, ex, ey, ew, vidx, vw, do_eval,
+                                cmask=None):
                 """One round through the lossy-link consensus: ``mats``
                 is the [D+1, n, n] per-staleness stack for the round
                 (slot 0 immediate; row-stochastic overall for
@@ -903,7 +987,43 @@ class GossipTrainer:
                 return (p_t, m_t, mass_out, new_buf, new_buf_mass,
                         pack_host_metrics(tl, ta, evalm, em, screened))
 
-            self._link_round_fn = jax.jit(link_round_fn,
+            self._link_round_fn = jax.jit(link_round_core,
+                                          donate_argnums=(0, 1, 2, 3, 4))
+
+            def link_block_fn(params, mom, mass, buf, buf_mass, mats,
+                              alive, limits, ts, idx, bw, is_eval,
+                              train_x, train_y, ex, ey, ew, vidx, vw,
+                              cmasks=None):
+                """k lossy-link rounds fused into one lax.scan: the
+                push-sum mass + in-flight/staleness buffers (engine
+                state) ride the CARRY, and the per-round [D+1, n, n]
+                per-staleness matrix stacks ride the scan as one more
+                stacked input ([k, D+1, n, n]) — exactly like the
+                corrupt masks.  The body IS ``link_round_core``, so the
+                per-round and blocked programs can never diverge."""
+
+                def body(carry, xs):
+                    p, m, ms, bf, bm = carry
+                    if has_corrupt:
+                        (mats_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t,
+                         cm_t) = xs
+                    else:
+                        mats_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
+                        cm_t = None
+                    p, m, ms, bf, bm, packed = link_round_core(
+                        p, m, ms, bf, bm, mats_t, alive_t, lim_t, t_t,
+                        idx_t, bw_t, train_x, train_y, ex, ey, ew, vidx,
+                        vw, ev_t, cm_t)
+                    return (p, m, ms, bf, bm), packed
+
+                xs = [mats, alive, limits, ts, idx, bw, is_eval]
+                if has_corrupt:
+                    xs.append(cmasks)
+                (params, mom, mass, buf, buf_mass), packed = jax.lax.scan(
+                    body, (params, mom, mass, buf, buf_mass), tuple(xs))
+                return params, mom, mass, buf, buf_mass, packed
+
+            self._link_block_fn = jax.jit(link_block_fn,
                                           donate_argnums=(0, 1, 2, 3, 4))
 
     def _run_blocked(self, rounds: int, block: int,
@@ -911,8 +1031,19 @@ class GossipTrainer:
                      checkpoint_path=None) -> History:
         """Run ``rounds`` rounds in fused blocks of up to ``block``.
         Periodic auto-checkpoints land at block boundaries (the state
-        only exists on the host there)."""
+        only exists on the host there).
+
+        EVERY gossip mode is blocked-eligible: clean/faulted runs fuse
+        as before; link-mode runs (msg_drop/msg_delay/push-sum) scan
+        with the mass + staleness buffers as carry and the per-round
+        [D+1, n, n] matrix stacks as stacked inputs; fused-quarantine
+        runs carry the streak/until state on device and the host
+        REPLAYS the per-round ledger logic post-fetch (same rows, same
+        order — the screened flags it needs only exist after the block
+        lands)."""
         cfg, g = self.cfg, self.cfg.gossip
+        link = self._link_mode
+        fused_quar = self._fused_quar
         block_sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
         )
@@ -924,11 +1055,23 @@ class GossipTrainer:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
             with self.timers.phase("host_batch_plan"):
-                pairs = [self._round_inputs(t) for t in ts]
-                w_mats = np.stack([p[0] for p in pairs])
-                alive = np.stack([p[1] for p in pairs])
-                limits = np.stack([p[2] for p in pairs])
-                frows = [p[4] for p in pairs]
+                if fused_quar:
+                    statics = [self._round_inputs_static(t) for t in ts]
+                    w_raws = [s[0] for s in statics]
+                    w_mats = np.stack([s[1] for s in statics])
+                    alive = np.stack([s[2] for s in statics])
+                    limits = np.stack([s[3] for s in statics])
+                    cmasks = (np.stack([s[4] for s in statics])
+                              if self._has_corrupt else None)
+                    frows = None
+                else:
+                    pairs = [self._round_inputs(t) for t in ts]
+                    w_mats = np.stack([p[0] for p in pairs])
+                    alive = np.stack([p[1] for p in pairs])
+                    limits = np.stack([p[2] for p in pairs])
+                    cmasks = (np.stack([p[3] for p in pairs])
+                              if self._has_corrupt else None)
+                    frows = [p[4] for p in pairs]
                 plans = [
                     make_batch_plan(self._plan_matrix_for_round(t),
                                     batch_size=g.local_bs,
@@ -943,24 +1086,57 @@ class GossipTrainer:
             is_eval = np.asarray(
                 [(t % self.eval_every) == 0 for t in ts], dtype=bool
             )
-            step_kw = ({"cmasks": jnp.asarray(
-                np.stack([p[3] for p in pairs]))}
-                if self._has_corrupt else {})
-            (self.params, self.momentum, self.x_hat,
-             packed) = self.timers.measure(
-                "round_step", self._block_fn,
-                self.params, self.momentum, self.x_hat, w_mats, alive,
-                limits, jnp.asarray(ts, jnp.int32), idx, bw,
-                jnp.asarray(is_eval), self._train_x, self._train_y,
-                *self._eval, *self._val, **step_kw,
-            )
+            step_kw = ({"cmasks": jnp.asarray(cmasks)}
+                       if self._has_corrupt else {})
+            common = (w_mats, alive, limits, jnp.asarray(ts, jnp.int32),
+                      idx, bw, jnp.asarray(is_eval), self._train_x,
+                      self._train_y, *self._eval, *self._val)
+            if link:
+                (self.params, self.momentum, self._mass, self._link_buf,
+                 self._link_buf_mass, packed) = self.timers.measure(
+                    "round_step", self._link_block_fn,
+                    self.params, self.momentum, self._mass,
+                    self._link_buf, self._link_buf_mass, *common,
+                    **step_kw,
+                )
+            elif fused_quar:
+                step_kw.update(
+                    streak=jnp.asarray(
+                        self._screen_streak.astype(np.int32)),
+                    until=jnp.asarray(
+                        self._quarantine_until.astype(np.int32)))
+                (self.params, self.momentum, self.x_hat, dev_streak,
+                 dev_until, packed) = self.timers.measure(
+                    "round_step", self._block_fn,
+                    self.params, self.momentum, self.x_hat, *common,
+                    **step_kw,
+                )
+            else:
+                (self.params, self.momentum, self.x_hat,
+                 packed) = self.timers.measure(
+                    "round_step", self._block_fn,
+                    self.params, self.momentum, self.x_hat, *common,
+                    **step_kw,
+                )
             packed = np.asarray(packed)  # ONE device→host fetch per block
             for j, t in enumerate(ts):
                 tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
                     packed[j])
-                if self._robust_active:
-                    self._apply_screen_feedback(t, alive[j], scr, frows[j])
-                self.history.faults.extend(frows[j])
+                if fused_quar:
+                    # Post-fetch ledger replay: host state is now
+                    # current through round t-1's flags, so this
+                    # regenerates exactly the per-round path's rows
+                    # (and host-mirror mutations) for round t.
+                    (_w, alive_j, _lim, _cm, rows_j,
+                     quar_j) = self._round_inputs(t, w_raw=w_raws[j])
+                    alive_eff = alive_j * (1.0 - quar_j)
+                    self._apply_screen_feedback(t, alive_eff, scr, rows_j)
+                    self.history.faults.extend(rows_j)
+                else:
+                    if self._robust_active:
+                        self._apply_screen_feedback(t, alive[j], scr,
+                                                    frows[j])
+                    self.history.faults.extend(frows[j])
                 row = {
                     "round": t,
                     "avg_train_loss": tl,
@@ -973,6 +1149,19 @@ class GossipTrainer:
                 if self._holdout:
                     self._append_client_rows(t, em)
                 self.round += 1
+            if fused_quar:
+                # The host replay and the device carry apply the same
+                # integer rule to the same flags — drift here means a
+                # real bug, caught loudly rather than as silent trace
+                # divergence.
+                if not (np.array_equal(np.asarray(dev_streak),
+                                       self._screen_streak.astype(np.int32))
+                        and np.array_equal(
+                            np.asarray(dev_until),
+                            self._quarantine_until.astype(np.int32))):
+                    raise RuntimeError(
+                        "fused-quarantine host replay diverged from the "
+                        "device scan carry")
             done += k
             if next_ckpt is not None and self.round >= next_ckpt:
                 self.save(checkpoint_path)
@@ -1027,12 +1216,37 @@ class GossipTrainer:
             return self.mixing.for_round(t)
         return np.eye(self.num_workers)
 
+    def _round_inputs_static(self, t: int):
+        """Quarantine-INDEPENDENT per-round inputs for the fused-
+        quarantine blocked path: (raw matrix draw, partition-cut f32
+        matrix, alive mask from crash/churn only, straggler limits,
+        raw corrupt mask).  Draws the round's matrix — the only
+        stateful draw — and touches NO quarantine state and emits NO
+        ledger rows; the blocked loop replays ``_round_inputs(t,
+        w_raw=...)`` post-fetch for the rows + host-mirror updates,
+        once the block's screened flags are back."""
+        w_raw = self._matrix_for_round(t)
+        rf = self.faults.for_round(t)
+        alive = (~rf.crashed).astype(np.float32)
+        if self.faults.has_churn:
+            away = self.faults.away_for_round(t)
+            alive = alive * (~away).astype(np.float32)
+        limits = FaultPlan.limits_for(rf, self._straggle_units)
+        w_t = w_raw
+        if rf.partition is not None:
+            w_t = repair_for_partition(w_t, rf.partition)
+        cmask = np.zeros(self.num_workers, np.float32)
+        if self._has_corrupt and rf.corrupt is not None:
+            cmask = (rf.corrupt & (alive > 0)).astype(np.float32)
+        return w_raw, w_t.astype(np.float32), alive, limits, cmask
+
     def _round_inputs(
-            self, t: int
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, list]:
+            self, t: int, w_raw: np.ndarray | None = None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, list,
+               np.ndarray]:
         """(mixing argument, alive mask, straggler limits, corrupt mask,
-        ledger rows) for round t, with the matrix repaired for any
-        failed or quarantined workers.
+        ledger rows, quarantine mask) for round t, with the matrix
+        repaired for any failed or quarantined workers.
 
         The mixing argument is the [n, n] matrix on the dense path or
         its [k, n] circulant coefficient table on the shift/ppermute
@@ -1042,15 +1256,24 @@ class GossipTrainer:
         (dopt.faults.FaultPlan) and ledger rows are RETURNED (not
         appended) so both execution paths interleave them with the
         device-side screened rows in the identical order — per-round,
-        blocked, and killed-and-resumed execution log the same trace."""
+        blocked, and killed-and-resumed execution log the same trace.
+
+        Under FUSED quarantine (dense robust path) the contract shifts:
+        the returned matrix is NOT dropout-repaired and ``alive``
+        excludes crash/churn only — the device folds the quarantine
+        mask in and repairs (``effective_inputs``), identically on the
+        per-round and blocked paths.  ``w_raw`` lets the blocked replay
+        reuse the plan-time matrix draw (the matching RNG is stateful).
+        """
         rows: list[dict] = []
-        w_t = self._matrix_for_round(t)
+        w_t = self._matrix_for_round(t) if w_raw is None else w_raw
         rf = self.faults.for_round(t)
         alive = (~rf.crashed).astype(np.float32)
         away = self.faults.away_for_round(t)
         if self.faults.has_churn:
             rows.extend(churn_ledger_rows(self.faults, t, away))
             alive = alive * (~away).astype(np.float32)
+        quar = np.zeros(self.num_workers, np.float32)
         if self._quarantine_on:
             expired = ((self._quarantine_until != 0)
                        & (t >= self._quarantine_until))
@@ -1060,10 +1283,12 @@ class GossipTrainer:
                 self._quarantine_until[i] = 0
                 self._screen_streak[i] = 0
             quarantined = self._quarantine_until > t
-            if quarantined.any():
+            quar = quarantined.astype(np.float32)
+            if quarantined.any() and not self._fused_quar:
                 # Quarantine rides the existing alive machinery: the
                 # matrix is repaired around the worker (neighbors stop
-                # listening) and its lane freezes for the span.
+                # listening) and its lane freezes for the span.  On the
+                # fused path this fold happens ON DEVICE instead.
                 alive = alive * (~quarantined).astype(np.float32)
         units = self._straggle_units
         limits = FaultPlan.limits_for(rf, units)
@@ -1075,7 +1300,7 @@ class GossipTrainer:
                 rows.append({"round": int(t), "worker": int(i),
                              "kind": "partition",
                              "action": f"cut_to_group_{int(gid)}"})
-        if alive.min() < 1.0:
+        if alive.min() < 1.0 and not self._fused_quar:
             w_t = repair_for_dropout(w_t, alive)
         for i in np.nonzero(rf.crashed)[0]:
             rows.append({"round": int(t), "worker": int(i), "kind": "crash",
@@ -1087,10 +1312,14 @@ class GossipTrainer:
         cmask = np.zeros(self.num_workers, np.float32)
         if self._has_corrupt and rf.corrupt is not None:
             # A down (or quarantined) worker sends nothing to corrupt.
+            # Fused path: the returned cmask keeps quarantined liars
+            # (the device mutes them), the LEDGER excludes them — same
+            # effective set either way.
             liars = rf.corrupt & (alive > 0)
             cmask = liars.astype(np.float32)
+            row_liars = liars & (quar <= 0) if self._fused_quar else liars
             mode = self.cfg.faults.corrupt_mode
-            for i in np.nonzero(liars)[0]:
+            for i in np.nonzero(row_liars)[0]:
                 rows.append({"round": int(t), "worker": int(i),
                              "kind": "corrupt",
                              "action": f"injected_{mode}"})
@@ -1120,11 +1349,11 @@ class GossipTrainer:
             m_eff = (push_sum_link_matrix(w_t, keep) if self._push_sum
                      else repair_for_link_drop(w_t, keep))
             mats = split_by_delay(m_eff, delay, self._delay_max)
-            return mats, alive, limits, cmask, rows
+            return mats, alive, limits, cmask, rows, quar
         if self._shift_ids is not None:
             return (coeffs_for_matrix(w_t, self._shift_ids), alive, limits,
-                    cmask, rows)
-        return w_t.astype(np.float32), alive, limits, cmask, rows
+                    cmask, rows, quar)
+        return w_t.astype(np.float32), alive, limits, cmask, rows, quar
 
     def _plan_matrix_for_round(self, t: int) -> np.ndarray:
         return self.faults.plan_matrix_for(t, self._train_matrix)
@@ -1174,13 +1403,12 @@ class GossipTrainer:
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
         block = g.block_rounds if block is None else block
-        if block > 1 and not self._quarantine_on and not self._link_mode:
-            # Quarantine stays per-round: the next round's alive mask
-            # depends on THIS round's device-side screen flags, which a
-            # fused block only surfaces at its end.  Link-mode runs
-            # (msg_drop/msg_delay/push-sum) stay per-round too: the
-            # per-staleness matrix stack is host data per round and the
-            # staleness buffers ride the carried engine state.
+        if block > 1:
+            # Every mode is blocked-eligible: quarantine rides the scan
+            # carry (streak/until on device, ledger replayed post-fetch),
+            # link-mode (msg_drop/msg_delay/push-sum) carries its mass +
+            # staleness buffers through the scan with the per-round
+            # [D+1, n, n] matrix stacks as stacked inputs.
             return self._run_blocked(rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
@@ -1188,7 +1416,8 @@ class GossipTrainer:
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                w_t, alive, limits, cmask, frows = self._round_inputs(t)
+                w_t, alive, limits, cmask, frows, quar = \
+                    self._round_inputs(t)
                 plan = make_batch_plan(
                     self._plan_matrix_for_round(t), batch_size=g.local_bs,
                     local_ep=g.local_ep,
@@ -1199,6 +1428,10 @@ class GossipTrainer:
             do_eval = (t % self.eval_every) == 0
             step_kw = ({"cmask": jnp.asarray(cmask)}
                        if self._has_corrupt else {})
+            if self._fused_quar:
+                # The quarantine fold + matrix repair happen ON DEVICE
+                # (effective_inputs), identically to the blocked path.
+                step_kw["quar"] = jnp.asarray(quar)
             if self._link_mode:
                 (self.params, self.momentum, self._mass, self._link_buf,
                  self._link_buf_mass, packed) = self.timers.measure(
@@ -1223,7 +1456,9 @@ class GossipTrainer:
             tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
                 np.asarray(packed))  # ONE device→host fetch per round
             if self._robust_active:
-                self._apply_screen_feedback(t, alive, scr, frows)
+                alive_eff = (alive * (1.0 - quar) if self._fused_quar
+                             else alive)
+                self._apply_screen_feedback(t, alive_eff, scr, frows)
             self.history.faults.extend(frows)
             row = {
                 "round": t,

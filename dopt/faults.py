@@ -33,7 +33,13 @@ state is carried between rounds — which is what makes fault traces
 (a) identical between per-round and fused-block execution, (b) exactly
 replayable from the config alone, and (c) crash-exact under
 checkpoint/resume: a run killed at round t and resumed sees precisely
-the faults a continuous run would.
+the faults a continuous run would.  Statelessness is also what lets
+the engines' fused blocked scans precompute a whole block's fault
+inputs up front and stack them as scan inputs ([k, ...] masks, limits,
+link-matrix stacks): since PR 4 EVERY fault kind — including the
+quarantine/staleness/push-sum modes whose round-to-round state now
+rides the scan carry — executes blocked with a bit-identical trace
+(docs/ARCHITECTURE.md "Everything is scan carry").
 
 Every injected fault is recorded in the run's **fault ledger**
 (``dopt.utils.metrics.History.faults``): one row per (round, worker,

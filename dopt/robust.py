@@ -14,6 +14,12 @@ the execution-path guarantees of the fault subsystem (bit-reproducible,
 blocked-exact, resume-exact).  Alive-counts are *data*, never shapes:
 the trimmed mean / median / Krum handle a dynamic survivor count via
 sorted-position weighting, so one compiled program serves every round.
+That counts-are-data discipline is load-bearing beyond this module: it
+is what the federated engine's fixed-width compact fault lanes and the
+fused-quarantine scan carry (PR 4) reuse to keep every degraded mode
+on the blocked execution path — the detection/quarantine layer's
+streak state now lives on device as int32 scan carry, with the host
+replaying the identical rule post-fetch for the ledger.
 
 * ``finite_lane_mask`` — non-finite screening: a lane with ANY NaN/Inf
   leaf entry is flagged, and the engines treat it as failed for the

@@ -450,6 +450,33 @@ def repair_for_dropout(w: np.ndarray, alive: np.ndarray) -> np.ndarray:
     return _repair_edges(w, a, force_identity=np.asarray(alive) <= 0)
 
 
+def repair_for_dropout_jnp(w, alive):
+    """``repair_for_dropout`` as a jittable device function.
+
+    Used by the fused-quarantine execution path, where the round's
+    alive mask is scan CARRY (the quarantine state lives on device), so
+    the matrix repair must happen inside the compiled round body.  Both
+    the per-round and the blocked quarantine paths call THIS function,
+    which is what makes their traces bit-identical: the host numpy
+    repair runs in float64, this one in the matrix dtype (f32).
+
+    ``alive`` is a 0/1 vector (any float dtype); semantics match the
+    numpy version exactly — dead edges dropped, surviving rows
+    renormalised, isolated/dead rows replaced by exact identity rows.
+    """
+    import jax.numpy as jnp
+
+    n = w.shape[0]
+    a = jnp.asarray(alive, w.dtype).reshape(1, n)
+    masked = w * a
+    rowsum = masked.sum(axis=1, keepdims=True)
+    safe = jnp.where(rowsum > 0, rowsum, jnp.ones_like(rowsum))
+    repaired = masked / safe
+    iso = (rowsum[:, 0] <= 0) | (a[0] <= 0)
+    eye = jnp.eye(n, dtype=w.dtype)
+    return jnp.where(iso[:, None], eye, repaired)
+
+
 def _repair_edges(w: np.ndarray, edge_mask: np.ndarray,
                   force_identity: np.ndarray | None = None) -> np.ndarray:
     """Shared healing core for dropout/partition repair: drop the

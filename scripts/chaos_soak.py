@@ -12,7 +12,12 @@ things a robust trainer owes you:
    ({round, worker, kind, action}, kind in ``dopt.faults.KINDS``, ids
    in range), and a rerun of the identical config reproduces the
    ledger row-for-row (the stateless-draw determinism contract).
-3. **Checkpoint invariants** — a run killed mid-soak and resumed from
+3. **Blocked-execution parity** — the fused multi-round ``lax.scan``
+   path (quarantine streaks, staleness buffers and push-sum mass ride
+   the scan carry) replays the per-round trace bit-identically, so
+   chaos runs at clean-run dispatch cost is a free speedup, not a
+   different experiment.
+4. **Checkpoint invariants** — a run killed mid-soak and resumed from
    its latest auto-checkpoint is bit-identical (History rows AND fault
    ledger) to the continuous run.  ``--kill`` does this the honest way:
    it spawns a child process, SIGKILLs it mid-round-loop, and resumes
@@ -147,6 +152,20 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     assert hr.rows == hc.rows and hr.faults == hc.faults, \
         "rerun diverged from the first run (stateless-draw contract broken)"
     print(f"[{engine}] deterministic replay ok")
+
+    # Blocked-execution parity: the fused lax.scan path (push-sum mass
+    # / staleness buffers / quarantine streaks as scan carry) must
+    # replay the identical trace — History rows AND ledger, content
+    # and order — at chaos-cocktail settings.  This is the degraded
+    # path the throughput work fused; bit-identity is what makes the
+    # speedup free.
+    blk = build_trainer(engine, seed, rounds)
+    hb = blk.run(rounds=rounds, block=max(rounds // 2, 2))
+    assert hb.rows == hc.rows, \
+        f"blocked History diverged from per-round ({engine})"
+    assert hb.faults == hc.faults, \
+        f"blocked fault ledger diverged from per-round ({engine})"
+    print(f"[{engine}] fused-block execution bit-identical ok")
 
     # Kill-and-resume bit-identity.
     path = os.path.join(ckpt_dir, f"{engine}-{seed}")

@@ -62,8 +62,12 @@ def main() -> int:
                 print(f"warning: TPU trajectory has {len(acc)} rounds "
                       f"<= oracle horizon {k}; same-round comparison "
                       "unavailable", file=sys.stderr)
-            r["tpu_final_minus_full_oracle"] = round(
-                r["final_acc"] - payload["oracle_final_acc_full"], 4)
+            fa = r.get("final_acc")
+            # final_acc can be None (run ended before any eval row);
+            # the delta is then an explicit null, not a TypeError.
+            r["tpu_final_minus_full_oracle"] = (
+                round(fa - payload["oracle_final_acc_full"], 4)
+                if fa is not None else None)
     ttt_path.write_text(json.dumps(ttt, indent=2) + "\n")
     print(f"merged into {ttt_path}")
     return 0

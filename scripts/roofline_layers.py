@@ -216,7 +216,8 @@ def main() -> int:
             fl, tf_g, tf_s = bench_layer(
                 r["spatial"], r["cin"], r["cout"], r["kernel"],
                 r["stride"], workers=workers, lane_batch=2 * lane_b,
-                iters=args.iters)
+                iters=args.iters,
+                pad=("VALID" if r["layer"].startswith("fc") else "SAME"))
             probes.append({"layer": r["layer"], "lane_batch": 2 * lane_b,
                            "grouped_tflops": round(tf_g, 2),
                            "single_tflops": round(tf_s, 2),

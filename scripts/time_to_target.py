@@ -267,8 +267,12 @@ def merge_full_oracle(row: dict) -> None:
         print(f"warning: TPU trajectory has {len(acc)} rounds <= full "
               f"oracle horizon {k}; same-round comparison unavailable",
               file=sys.stderr)
-    row["tpu_final_minus_full_oracle"] = round(
-        row["final_acc"] - payload["oracle_final_acc_full"], 4)
+    fa = row.get("final_acc")
+    # A row whose run never reached a final eval carries final_acc=None
+    # — write an explicit null delta instead of crashing the merge.
+    row["tpu_final_minus_full_oracle"] = (
+        round(fa - payload["oracle_final_acc_full"], 4)
+        if fa is not None else None)
 
 
 def add_dtype_control(out_path: Path, *, target: float, quick: bool,

@@ -1,0 +1,327 @@
+"""Per-round vs fused-blocked bit-identity for the degraded modes.
+
+PR 4 made every fault/robust/network mode eligible for the fused
+multi-round ``lax.scan`` path by moving its round-to-round state on
+device as scan carry: gossip/federated quarantine streaks (int32 carry
++ on-device matrix repair), the federated staleness one-slot buffer and
+its admission schedule, push-sum mass + in-flight packet buffers (with
+the per-staleness ``[D+1, n, n]`` link-matrix stacks as stacked scan
+inputs), and fixed-width validity-masked compact fault lanes (survivor
+counts are data, not shapes).
+
+The contract these tests pin, per mode: ``block=1`` and ``block=k``
+produce IDENTICAL History rows, fault-ledger rows (content AND order)
+and final device state — plus kill-and-resume mid-block under the full
+chaos cocktail.  Fast invariants run tier-1; everything that builds an
+engine is ``slow`` (the tier-1 wall-clock budget is nearly full).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig, RobustConfig)
+
+pytestmark = pytest.mark.network
+
+_DATA = DataConfig(dataset="synthetic", num_users=6, iid=True,
+                   synthetic_train_size=192, synthetic_test_size=64)
+_FDATA = dataclasses.replace(_DATA, num_users=8, synthetic_train_size=256)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
+
+
+def _gossip_cfg(faults=None, robust=None, **gkw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=32)
+    g.update(gkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, gossip=GossipConfig(**g),
+                            faults=faults, robust=robust)
+
+
+def _fed_cfg(faults=None, robust=None, **fkw):
+    f = dict(algorithm="fedavg", frac=1.0, rounds=4, local_ep=1,
+             local_bs=32)
+    f.update(fkw)
+    return ExperimentConfig(name="t", seed=7, data=_FDATA, model=_MODEL,
+                            optim=_OPTIM, federated=FederatedConfig(**f),
+                            faults=faults, robust=robust)
+
+
+def _assert_trace_equal(ta, tb, what, params=("params",)):
+    """History rows, fault ledger (content and ORDER), and the named
+    device-state trees must be bit-identical."""
+    import jax
+
+    assert ta.history.rows == tb.history.rows, f"{what}: history diverged"
+    assert ta.history.faults == tb.history.faults, f"{what}: ledger diverged"
+    for name in params:
+        a = jax.device_get(getattr(ta, name))
+        b = jax.device_get(getattr(tb, name))
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(la, lb, err_msg=f"{what}: {name}")
+
+
+# ---------------------------------------------------------------------------
+# Fast invariants (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_repair_for_dropout_jnp_matches_numpy():
+    # The fused-quarantine path repairs the mixing matrix ON DEVICE;
+    # its semantics must match the host repair exactly: dead/isolated
+    # rows become exact identity rows, surviving rows stay stochastic.
+    from dopt.topology import (build_mixing_matrices, repair_for_dropout,
+                               repair_for_dropout_jnp)
+
+    rng = np.random.default_rng(0)
+    for topo in ("circle", "complete"):
+        w = build_mixing_matrices(topo, "metropolis", 6).for_round(0)
+        w32 = w.astype(np.float32)
+        for _ in range(4):
+            alive = (rng.random(6) > 0.4).astype(np.float32)
+            host = repair_for_dropout(w32.astype(np.float64), alive)
+            dev = np.asarray(repair_for_dropout_jnp(w32, alive))
+            np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+            # Dead rows are EXACT identity on both paths (no float slop
+            # — a dead worker's carried state must freeze bit-exactly).
+            for i in np.nonzero(alive <= 0)[0]:
+                expect = np.eye(6, dtype=np.float32)[i]
+                np.testing.assert_array_equal(dev[i], expect)
+    # All-alive repair is exactly row-renormalisation; rows stay
+    # stochastic under partial failure.
+    alive = np.asarray([1, 0, 1, 1, 0, 1], np.float32)
+    dev = np.asarray(repair_for_dropout_jnp(
+        build_mixing_matrices("circle", "metropolis", 6)
+        .for_round(0).astype(np.float32), alive))
+    np.testing.assert_allclose(dev.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_sharded_eval_batches_more_workers_than_samples():
+    # Satellite: workers > n used to crash on the wraparound pad-fill
+    # (empty shard broadcast into a non-empty slice).  Empty shards now
+    # keep zero indices at weight 0: valid gathers, zero contribution,
+    # and the total weight still covers every sample exactly once.
+    from dopt.data import sharded_eval_batches
+
+    idx, wt = sharded_eval_batches(3, 5, batch_size=4)
+    assert idx.shape[0] == 5 and wt.shape == idx.shape
+    assert wt.sum() == 3.0                    # each sample counted once
+    assert (idx >= 0).all() and (idx < 3).all()
+    for i in (3, 4):                          # empty shards: weight 0
+        assert wt[i].sum() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Per-mode per-round vs blocked bit-identity (engine runs — slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gossip_quarantine_blocked_parity(devices):
+    # The newly fused mode with real detection dynamics: a persistent
+    # nan liar is screened, quarantined, readmitted and reoffends —
+    # with the streak/until state as scan carry on the blocked path.
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan")
+    rc = RobustConfig(clip_radius=1.0, quarantine_after=2,
+                      quarantine_rounds=2)
+    ta = GossipTrainer(_gossip_cfg(fc, robust=rc))
+    ta.run(rounds=6, block=1)
+    tb = GossipTrainer(_gossip_cfg(fc, robust=rc))
+    tb.run(rounds=6, block=3)
+    _assert_trace_equal(ta, tb, "gossip quarantine")
+    acts = [r["action"] for r in ta.history.faults if r["worker"] == 0]
+    assert any(a.startswith("quarantined_until") for a in acts), acts
+    assert "readmitted" in acts
+
+
+@pytest.mark.slow
+def test_gossip_linkdrop_blocked_parity(devices):
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.3)
+    ta = GossipTrainer(_gossip_cfg(fc))
+    ta.run(rounds=4, block=1)
+    tb = GossipTrainer(_gossip_cfg(fc))
+    tb.run(rounds=4, block=4)
+    _assert_trace_equal(ta, tb, "link drop")
+    assert any(r["kind"] == "msg_drop" for r in ta.history.faults)
+
+
+@pytest.mark.slow
+def test_gossip_pushsum_blocked_parity(devices):
+    # Push-sum mass and the in-flight packet buffers are scan carry;
+    # the [D+1, n, n] per-staleness stacks are stacked scan inputs.
+    # Mass + buffers must come out of the fused block bit-identical.
+    import jax
+
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.2, msg_delay=0.3, msg_delay_max=2)
+    ta = GossipTrainer(_gossip_cfg(fc, correction="push_sum"))
+    ta.run(rounds=5, block=1)
+    tb = GossipTrainer(_gossip_cfg(fc, correction="push_sum"))
+    tb.run(rounds=5, block=3)
+    _assert_trace_equal(ta, tb, "push-sum")
+    np.testing.assert_array_equal(np.asarray(ta._mass),
+                                  np.asarray(tb._mass))
+    for la, lb in zip(jax.tree.leaves(jax.device_get(ta._link_buf)),
+                      jax.tree.leaves(jax.device_get(tb._link_buf))):
+        np.testing.assert_array_equal(la, lb)
+    np.testing.assert_array_equal(np.asarray(ta._link_buf_mass),
+                                  np.asarray(tb._link_buf_mass))
+
+
+@pytest.mark.slow
+def test_federated_staleness_blocked_parity(devices):
+    # Deadline-missed stragglers and delayed uplinks are captured into
+    # the one-slot device buffer and admitted <= K rounds late at decay
+    # weight — capture/admission now decided ON DEVICE inside the scan.
+    import jax
+
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(straggle=0.6, straggle_frac=0.5,
+                     straggler_policy="drop", over_select=0.3,
+                     msg_drop=0.1, msg_delay=0.2, msg_delay_max=2)
+    ta = FederatedTrainer(_fed_cfg(fc, frac=0.5, staleness_max=2,
+                                   staleness_decay=0.7))
+    ta.run(rounds=6, block=1)
+    tb = FederatedTrainer(_fed_cfg(fc, frac=0.5, staleness_max=2,
+                                   staleness_decay=0.7))
+    tb.run(rounds=6, block=3)
+    _assert_trace_equal(ta, tb, "staleness", params=("theta", "params"))
+    for la, lb in zip(jax.tree.leaves(jax.device_get(ta._stale_p)),
+                      jax.tree.leaves(jax.device_get(tb._stale_p))):
+        np.testing.assert_array_equal(la, lb)
+    assert any(r["kind"] == "staleness" for r in ta.history.faults)
+
+
+@pytest.mark.slow
+def test_federated_quarantine_blocked_parity(devices):
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(corrupt=1.0, corrupt_max=1, corrupt_mode="nan")
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=2)
+    ta = FederatedTrainer(_fed_cfg(fc, robust=rc))
+    ta.run(rounds=8, block=1)
+    tb = FederatedTrainer(_fed_cfg(fc, robust=rc))
+    tb.run(rounds=8, block=4)
+    _assert_trace_equal(ta, tb, "fed quarantine", params=("theta", "params"))
+    acts = [r["action"] for r in ta.history.faults if r["worker"] == 0]
+    assert any(a.startswith("quarantined_until") for a in acts), acts
+
+
+@pytest.mark.slow
+def test_federated_stale_plus_quarantine_blocked_parity(devices):
+    # The composition case: buffered late updates from a worker that
+    # gets quarantined mid-flight are dropped on admission; both the
+    # admission schedule AND the quarantine state ride the same carry.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(straggle=0.5, straggle_frac=0.5,
+                     straggler_policy="drop", corrupt=0.4,
+                     corrupt_mode="nan", msg_delay=0.2, msg_delay_max=2)
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+    ta = FederatedTrainer(_fed_cfg(fc, frac=0.5, staleness_max=2,
+                                   robust=rc))
+    ta.run(rounds=8, block=1)
+    tb = FederatedTrainer(_fed_cfg(fc, frac=0.5, staleness_max=2,
+                                   robust=rc))
+    tb.run(rounds=8, block=4)
+    _assert_trace_equal(ta, tb, "stale+quar", params=("theta", "params"))
+
+
+@pytest.mark.slow
+def test_compact_faults_fixed_width_blocked_parity(devices):
+    # Compact + faults: survivor counts are DATA (validity-masked
+    # fixed-width lanes), so faulted compact rounds share one compiled
+    # program and fuse into blocks.  Full-width stays the semantic
+    # reference: identical ledger, metrics equal to tolerance (the
+    # sampled mean sums lanes in a different order).
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(crash=0.2, straggle=0.3, straggle_frac=0.5,
+                     corrupt=0.3, corrupt_mode="signflip")
+    ca = dataclasses.replace(_fed_cfg(fc, frac=0.5, compact=True),
+                             mesh_devices=1)
+    ta = FederatedTrainer(ca)
+    ta.run(rounds=5, block=1)
+    tb = FederatedTrainer(dataclasses.replace(ca))
+    tb.run(rounds=5, block=5)
+    _assert_trace_equal(ta, tb, "compact faults",
+                        params=("theta", "params"))
+    tf = FederatedTrainer(dataclasses.replace(
+        _fed_cfg(fc, frac=0.5, compact=False), mesh_devices=1))
+    tf.run(rounds=5)
+    assert tf.history.faults == tb.history.faults
+    for rc_, rf_ in zip(tb.history.rows, tf.history.rows):
+        for k in rc_:
+            np.testing.assert_allclose(rc_[k], rf_[k], rtol=2e-4,
+                                       atol=2e-5)
+
+
+@pytest.mark.slow
+def test_gossip_cocktail_blocked_parity(devices):
+    # The bench.py chaos cocktail: msg_drop + straggle + corrupt(scale)
+    # + quarantine armed, through the link consensus path (quarantine
+    # composes via the alive machinery).
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.1, straggle=0.3, straggle_frac=0.5,
+                     corrupt=0.2, corrupt_mode="scale", corrupt_scale=5.0)
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+    ta = GossipTrainer(_gossip_cfg(fc, robust=rc))
+    ta.run(rounds=4, block=1)
+    tb = GossipTrainer(_gossip_cfg(fc, robust=rc))
+    tb.run(rounds=4, block=4)
+    _assert_trace_equal(ta, tb, "gossip cocktail")
+
+
+@pytest.mark.parametrize("engine", [
+    pytest.param("gossip", marks=pytest.mark.slow),
+    pytest.param("federated", marks=pytest.mark.slow),
+])
+def test_cocktail_kill_and_resume_mid_block(engine, tmp_path, devices):
+    # Blocked chaos execution checkpoints at block boundaries; a run
+    # killed there and resumed (still blocked) must be bit-identical to
+    # the continuous blocked run — carry state (quarantine streaks,
+    # staleness schedule, buffers, push-sum mass) reloads exactly.
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    if engine == "gossip":
+        fc = FaultConfig(msg_drop=0.15, msg_delay=0.2, msg_delay_max=2,
+                         straggle=0.3, straggle_frac=0.5,
+                         corrupt=0.2, corrupt_mode="scale",
+                         corrupt_scale=5.0)
+        rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+
+        def make():
+            return GossipTrainer(_gossip_cfg(fc, robust=rc,
+                                             correction="push_sum"))
+    else:
+        fc = FaultConfig(straggle=0.5, straggle_frac=0.5,
+                         straggler_policy="drop", corrupt=0.4,
+                         corrupt_mode="nan", msg_delay=0.2,
+                         msg_delay_max=2)
+        rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+
+        def make():
+            return FederatedTrainer(_fed_cfg(fc, frac=0.5,
+                                             staleness_max=2, robust=rc))
+
+    cont = make()
+    hc = cont.run(rounds=8, block=2)
+    path = tmp_path / f"{engine}-ckpt"
+    part = make()
+    part.run(rounds=4, block=2, checkpoint_every=2, checkpoint_path=path)
+    res = make()
+    res.restore(path)
+    assert res.round == 4
+    hr = res.run(rounds=4, block=2)
+    assert hr.rows == hc.rows
+    assert hr.faults == hc.faults

@@ -448,13 +448,22 @@ def test_link_mode_validation(devices):
         GossipTrainer(_gossip_cfg(fc, algorithm="fedlcon", eps=2))
     with pytest.raises(ValueError, match="comm_dtype"):
         GossipTrainer(_gossip_cfg(fc, comm_dtype="bfloat16"))
-    with pytest.raises(ValueError, match="do not compose"):
+    with pytest.raises(ValueError, match="does not compose"):
         GossipTrainer(ExperimentConfig(
             name="t", seed=1, data=_LDATA, model=_LMODEL,
             optim=OptimizerConfig(lr=0.1),
             gossip=GossipConfig(algorithm="dsgd", topology="circle",
                                 mode="metropolis"),
             faults=fc, robust=RobustConfig(clip_radius=1.0)))
+    # Quarantine, by contrast, now COMPOSES with link faults (it acts
+    # through the alive machinery before the link repairs) — the
+    # trainer must construct.
+    GossipTrainer(ExperimentConfig(
+        name="t", seed=1, data=_LDATA, model=_LMODEL,
+        optim=OptimizerConfig(lr=0.1),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis"),
+        faults=fc, robust=RobustConfig(quarantine_after=2)))
     with pytest.raises(ValueError, match="unknown gossip correction"):
         GossipTrainer(_gossip_cfg(None, correction="psum"))
     with pytest.raises(ValueError, match="msg_drop"):
