@@ -45,8 +45,20 @@ through the scan (XLA picks worse conv layouts, +6% device time).
 Eval is evaluated OUTSIDE the measured window (it is a metric, not
 the workload).
 
+Round 6: the fast leg defaults to ``update_sharding="scatter"`` (the
+bucketed reduce-scatter consensus/update hot path with the XLA
+latency-hiding scheduler armed — arXiv:2004.13336 applied to the
+mixing round; ``--update-sharding off`` reverts), the wall measurement
+is outlier-hardened (min/max-trimmed median + a ``--max-spread`` retry
+gate — the r5 27.4% raw spread made single-window walls meaningless),
+and the traced blocks additionally report the conv / mixing-comm /
+update fractions of device time (named-scope attribution,
+``dopt.utils.profiling.classify_phase``) so the "conv fraction" claim
+is measured, not guessed.
+
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N, ...}
+  {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N,
+   "conv_fraction": f, "comm_fraction": f, "update_fraction": f, ...}
 """
 
 from __future__ import annotations
@@ -74,7 +86,7 @@ def _device_peak_flops() -> tuple[str, float | None]:
 
 
 def _config(*, fast: bool, train_size: int, test_size: int,
-            faithful_model: bool = True):
+            faithful_model: bool = True, update_sharding: str = "off"):
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
                              ModelConfig, OptimizerConfig)
 
@@ -101,7 +113,8 @@ def _config(*, fast: bool, train_size: int, test_size: int,
             clip_norm=1.0 if (fast and not faithful_model) else 0.0),
         gossip=GossipConfig(algorithm="dsgd", topology="circle",
                             mode="stochastic", rounds=10, local_ep=4,
-                            local_bs=128),
+                            local_bs=128,
+                            update_sharding=update_sharding),
     )
 
 
@@ -169,25 +182,45 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
     }
 
 
+def _trimmed_stats(values):
+    """Outlier-hardened reduction of per-block rounds/sec samples:
+    with >= 4 samples the min and max are DISCARDED (the tunneled chip
+    throws occasional multi-second stalls that poison a plain
+    max−min spread), then (median, spread_pct, kept) over the
+    survivors; spread_pct = (max−min)/median·100 of the kept set."""
+    import statistics
+
+    vals = sorted(float(v) for v in values)
+    kept = vals[1:-1] if len(vals) >= 4 else vals
+    med = statistics.median(kept)
+    spread = 100.0 * (kept[-1] - kept[0]) / med if med > 0 else 0.0
+    return med, spread, kept
+
+
 def _measure(cfg, rounds: int, block: int, repeats: int = 5,
-             device_blocks: int = 0):
+             device_blocks: int = 0, max_spread: float = 0.0,
+             max_retries: int = 2):
     """Warm up (compile), then time ``repeats`` independent blocks of
-    ``rounds`` rounds each and take the MEDIAN — the tunneled chip shows
-    ±8% wall-clock variance on identical code (VERDICT r3), so a single
-    window makes round-over-round comparisons noise-limited.  Evaluation
-    stays OUT of the measured loop (eval is a metric, not the workload;
-    the reference times its rounds the same way).
+    ``rounds`` rounds each and reduce via ``_trimmed_stats`` — the
+    tunneled chip shows ±8-27% wall-clock variance on identical code
+    (VERDICT r3/r5), so a single window makes round-over-round
+    comparisons noise-limited and untrimmed spreads are stall-poisoned.
+    ``max_spread`` > 0 arms the retry gate: while the trimmed spread
+    exceeds it (and retries remain), ``repeats`` more blocks are timed
+    and the reduction re-runs over ALL samples.  Evaluation stays OUT
+    of the measured loop (eval is a metric, not the workload; the
+    reference times its rounds the same way).
 
     ``device_blocks`` > 0 additionally runs that many profiler-traced
     blocks and reports DEVICE-self-time rounds/sec — the tunnel-immune
-    basis (wall-clock on this chip rides a network tunnel whose jitter
-    the program cannot control; device time is what the TPU actually
-    spent).
+    basis — plus the conv/comm/update phase fractions of device time
+    (``dopt.utils.profiling.phase_totals`` over the trace).
 
-    Returns a dict: rounds/sec (median), post-run avg test acc, total
-    measured seconds, samples/sec, spread_pct ((max−min)/median·100
-    over per-block rounds/sec), total trained rounds, and — when traced
-    — device_ms_per_round (median) + device-basis rounds/sec + spread.
+    Returns a dict: rounds/sec (trimmed median), spread_pct (trimmed)
+    + spread_pct_raw, wall_retries/measured_blocks_total, post-run avg
+    test acc, total measured seconds, samples/sec, total trained
+    rounds, and — when traced — device_ms_per_round + device-basis
+    rounds/sec + spread + phase_fractions.
     """
     import statistics
 
@@ -195,7 +228,8 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
 
     # eval_every > total rounds dispatched => the measured block carries
     # zero eval steps (lax.cond skips the branch's work at runtime).
-    total_dispatch = rounds * (repeats + device_blocks + 2)
+    total_dispatch = rounds * (repeats * (1 + max_retries)
+                               + device_blocks + 2)
     trainer = GossipTrainer(cfg, eval_every=10 * total_dispatch + 97)
     # Warmup: compile the fused block step for every block size the
     # measured loop will dispatch (the remainder block retraces).
@@ -208,30 +242,49 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
 
     rps = []
     total = 0.0
-    for _ in range(repeats):
-        t0 = time.time()
-        trainer.run(rounds=rounds, block=block)
-        jax.block_until_ready(trainer.params)
-        elapsed = time.time() - t0
-        total += elapsed
-        rps.append(rounds / elapsed)
-        trained += rounds
-    med = statistics.median(rps)
+
+    def time_blocks(n):
+        nonlocal total, trained
+        for _ in range(n):
+            t0 = time.time()
+            trainer.run(rounds=rounds, block=block)
+            jax.block_until_ready(trainer.params)
+            elapsed = time.time() - t0
+            total += elapsed
+            rps.append(rounds / elapsed)
+            trained += rounds
+
+    time_blocks(repeats)
+    med, spread, _ = _trimmed_stats(rps)
+    retries = 0
+    while max_spread > 0 and spread > max_spread and retries < max_retries:
+        # The wall number is meaningless at this spread — buy more
+        # samples and re-reduce (the gate the 27.4% r5 spread demanded).
+        retries += 1
+        print(f"# wall spread {spread:.1f}% > {max_spread:.1f}%: retry "
+              f"{retries}/{max_retries} with {repeats} more blocks",
+              file=sys.stderr)
+        time_blocks(repeats)
+        med, spread, _ = _trimmed_stats(rps)
     samples_per_round = (trainer.num_workers * cfg.gossip.local_ep
                          * trainer._train_matrix.shape[1])
     out = {
         "rounds_per_sec": med,
-        "spread_pct": 100.0 * (max(rps) - min(rps)) / med,
+        "spread_pct": spread,
+        "spread_pct_raw": (100.0 * (max(rps) - min(rps))
+                           / statistics.median(rps)),
+        "wall_retries": retries,
+        "measured_blocks_total": len(rps),
         "measured_seconds": total,
         "samples_per_sec": med * samples_per_round,
     }
     if device_blocks:
         try:
-            from dopt.utils.profiling import device_time_of
+            from dopt.utils.profiling import PHASES, device_stats_of
 
             def one_block():
                 # Count INSIDE the block: rounds trained before a
-                # device_time_of failure partway through still reflect
+                # device_stats_of failure partway through still reflect
                 # in fast_total_trained_rounds (the accuracy column's
                 # denominator must match what actually ran).
                 nonlocal trained
@@ -239,13 +292,25 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
                 jax.block_until_ready(trainer.params)
                 trained += rounds
 
-            dev_us = [device_time_of(one_block)
-                      for _ in range(device_blocks)]
+            dev_us, phase_us = [], {k: 0.0 for k in PHASES}
+            for _ in range(device_blocks):
+                stats = device_stats_of(one_block)
+                dev_us.append(stats["device_self_time_us"])
+                ph = stats.get("device_phases", {})
+                for k in PHASES:
+                    phase_us[k] += float(ph.get(f"{k}_us", 0.0))
             dev_ms = statistics.median(dev_us) / 1e3 / rounds
             out["device_ms_per_round"] = dev_ms
             out["device_rounds_per_sec"] = 1e3 / dev_ms
             out["device_spread_pct"] = (100.0 * (max(dev_us) - min(dev_us))
                                         / statistics.median(dev_us))
+            tot_us = sum(phase_us.values())
+            if tot_us > 0:
+                # Conv / mixing-comm / update split of device time over
+                # all traced blocks (named-scope + op-category
+                # attribution, dopt.utils.profiling.classify_phase).
+                out["phase_fractions"] = {
+                    k: round(v / tot_us, 4) for k, v in phase_us.items()}
         except Exception as e:  # pragma: no cover - environment-dependent
             # The device-time basis needs the profiler + xprof stack;
             # its absence (or a tunnel hiccup) must not take down the
@@ -277,8 +342,22 @@ def main() -> None:
                     help="measure only the fast (bf16) mode")
     ap.add_argument("--repeats", type=int, default=5,
                     help="independent measured blocks; the reported value "
-                         "is their median (variance hardening: the tunneled "
-                         "chip shows ±8%% single-window wall-clock noise)")
+                         "is their min/max-trimmed median (variance "
+                         "hardening: the tunneled chip shows ±8-27%% "
+                         "single-window wall-clock noise)")
+    ap.add_argument("--max-spread", type=float, default=10.0,
+                    help="wall-spread gate (%%): while the trimmed "
+                         "per-block rounds/sec spread exceeds this, the "
+                         "measurement retries with --repeats more blocks "
+                         "(up to 2 retries); 0 disables the gate")
+    ap.add_argument("--update-sharding", choices=("off", "scatter"),
+                    default="scatter",
+                    help="fast-leg consensus/update execution mode "
+                         "(GossipConfig.update_sharding): 'scatter' runs "
+                         "the bucketed reduce-scatter hot path with the "
+                         "XLA latency-hiding scheduler armed; the "
+                         "faithful f32 leg always runs 'off' (the "
+                         "oracle-parity program)")
     ap.add_argument("--device-blocks", type=int, default=3,
                     help="profiler-traced blocks for the device-time-basis "
                          "rounds/sec (tunnel-immune; 0 disables)")
@@ -289,6 +368,14 @@ def main() -> None:
                          "architecture; same JSON fields, metric suffixed "
                          "_idiomatic")
     args = ap.parse_args()
+
+    if args.update_sharding == "scatter":
+        # XLA reads its flags at backend init: arm the latency-hiding
+        # scheduler BEFORE the first jax use so the scatter path's
+        # per-bucket collectives can overlap with compute.
+        from dopt.parallel.mesh import enable_latency_hiding_scheduler
+
+        enable_latency_hiding_scheduler()
 
     if args.quick:
         # CI-artifact mode: tiny data, two measured rounds per path —
@@ -314,10 +401,13 @@ def main() -> None:
     faithful_model = not args.idiomatic
     repeats = 2 if args.smoke else args.repeats
     device_blocks = 0 if args.smoke else args.device_blocks
+    max_spread = 0.0 if args.smoke else args.max_spread
     fast = _measure(
         _config(fast=True, train_size=train_size, test_size=test_size,
-                faithful_model=faithful_model),
-        rounds, block, repeats, device_blocks=device_blocks)
+                faithful_model=faithful_model,
+                update_sharding=args.update_sharding),
+        rounds, block, repeats, device_blocks=device_blocks,
+        max_spread=max_spread)
     kind, peak = _device_peak_flops()
     fast_sps = fast["samples_per_sec"]
     result = {
@@ -327,8 +417,11 @@ def main() -> None:
         "unit": "rounds/sec",
         "vs_baseline": round(fast["rounds_per_sec"]
                              / REFERENCE_ROUNDS_PER_SEC, 2),
+        "update_sharding": args.update_sharding,
         "spread_pct": round(fast["spread_pct"], 2),
-        "measured_blocks": repeats,
+        "spread_pct_raw": round(fast["spread_pct_raw"], 2),
+        "wall_retries": fast["wall_retries"],
+        "measured_blocks": fast["measured_blocks_total"],
         "rounds_per_block": rounds,
         "fast_avg_test_acc": round(fast["avg_test_acc"], 4),
         "fast_total_trained_rounds": fast["total_trained_rounds"],
@@ -345,6 +438,15 @@ def main() -> None:
             fast["device_rounds_per_sec"], 4)
         result["device_spread_pct"] = round(fast["device_spread_pct"], 2)
         result["device_blocks"] = device_blocks
+    if "phase_fractions" in fast:
+        # Conv / mixing-comm / update split of device time — the
+        # measured basis for "conv fraction >= X%" claims (named-scope
+        # attribution, dopt.utils.profiling.classify_phase).
+        pf = fast["phase_fractions"]
+        result["conv_fraction"] = pf["conv"]
+        result["comm_fraction"] = pf["comm"]
+        result["update_fraction"] = pf["update"]
+        result["other_fraction"] = pf["other"]
     if peak:
         result["mfu_vs_bf16_peak"] = round(
             fast_sps * MODEL1_TRAIN_FLOPS_PER_SAMPLE / peak, 4)

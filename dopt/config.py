@@ -156,6 +156,27 @@ class FederatedConfig:
     # update admitted d rounds late enters the weighted average with
     # weight decay**d (1.0 = late counts like fresh, small = distrust
     # stale work).
+    update_sharding: str = "off"
+    # "off" | "scatter".  "scatter" runs the aggregation/weight-update
+    # hot path sharded (Xu et al., arXiv:2004.13336): the parameter
+    # tree is flattened into size-bounded buckets
+    # (``update_bucket_mb``), each device reduce-scatters its masked
+    # partial sums so it owns only a 1/D shard of the flat sum, the
+    # aggregation update (the divide) runs on that shard, and one
+    # all-gather re-forms the replicated theta — instead of every
+    # device redundantly computing the full |θ| average.  Per-bucket
+    # collectives overlap with compute under the XLA latency-hiding
+    # scheduler (dopt.parallel.mesh.enable_latency_hiding_scheduler).
+    # "off" compiles the exact pre-change program (bit-identical).
+    # Requires aggregator='mean', no comm_dtype/staleness/compact, and
+    # a flat 1-D mesh; numerics match the dense path to f32 summation
+    # order (allclose, not bit-equal), and scatter-vs-scatter runs are
+    # bit-reproducible and resume-exact.
+    update_bucket_mb: float = 4.0
+    # Scatter-mode bucket size bound (per-worker payload MB per
+    # bucket): small enough that several collectives are in flight for
+    # the scheduler to overlap, large enough to amortise collective
+    # launch overhead.
 
 
 @dataclass(frozen=True)
@@ -256,6 +277,28 @@ class GossipConfig:
     # traces.  "push_sum" forces the dense comm path and per-round
     # execution; with no link faults and a doubly-stochastic schedule
     # the mass stays exactly 1.0 (divide/multiply by 1.0 is exact).
+    update_sharding: str = "off"
+    # "off" | "scatter".  "scatter" runs the consensus mix on a 1/D
+    # shard of the FLATTENED parameter tree (arXiv:2004.13336 applied
+    # to gossip): the tree is bucketed into size-bounded [W, Fb] slabs
+    # (``update_bucket_mb``), the dense mix becomes per-device partial
+    # contraction + ``psum_scatter`` (no device ever materialises the
+    # [n, |θ|] gathered fleet state), the ppermute/shift schedule runs
+    # as a sharded circulant contraction over the same flat buckets,
+    # and the per-bucket collectives overlap with compute under the
+    # XLA latency-hiding scheduler.  "off" compiles the exact
+    # pre-change program (bit-identical).  Eligible for dsgd/fedlcon/
+    # gossip with crash/straggler/partition/churn faults and blocked
+    # execution; rejected (loudly) with the robust layer, link faults/
+    # push-sum, choco, comm_dtype, and hybrid meshes.  Numerics: f32
+    # trees agree with the dense path to summation order (the
+    # allclose-pinned contract); bf16 trees additionally keep the
+    # mixing matrix + accumulation in f32 where the dense path
+    # contracts at bf16 — strictly more precise, but a larger delta vs
+    # dense.  Scatter-vs-scatter is bit-reproducible and resume-exact.
+    update_bucket_mb: float = 4.0
+    # Scatter-mode bucket size bound (per-worker payload MB per
+    # bucket); see FederatedConfig.update_bucket_mb.
     dropout: float = 0.0
     # DEPRECATED back-compat alias for FaultConfig(crash=p) — warns at
     # trainer construction and produces the identical fault trace

@@ -48,7 +48,10 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
 from dopt.faults import FaultPlan, churn_ledger_rows, corrupt_update
 from dopt.models import build_model, count_params
 from dopt.optim import admm_dual_ascent, scaffold_control_update
-from dopt.parallel.collectives import (broadcast_to_workers, masked_average,
+from dopt.parallel.collectives import (broadcast_to_workers,
+                                        make_update_shard_spec,
+                                        masked_average,
+                                        masked_average_scatter,
                                         where_mask as _where_mask)
 from dopt.robust import (clip_to_ball, finite_lane_mask, make_aggregator,
                          masked_mean, validate_robust_config)
@@ -146,6 +149,54 @@ class FederatedTrainer:
         self._quarantine_rounds = rcfg.quarantine_rounds if rcfg else 0
         self._screen_streak = np.zeros(w, np.int64)
         self._quarantine_until = np.zeros(w, np.int64)
+
+        # Sharded weight-update hot path (ISSUE 5 tentpole): the masked
+        # aggregation runs as reduce-scatter + 1/D-shard update + one
+        # all-gather over size-bounded flat buckets instead of every
+        # device redundantly forming the full replicated theta
+        # (dopt.parallel.collectives.masked_average_scatter).  "off"
+        # keeps the exact pre-change programs (python gating).
+        if f.update_sharding not in ("off", "scatter"):
+            raise ValueError(
+                f"unknown update_sharding {f.update_sharding!r}; "
+                "one of off|scatter")
+        self._scatter = f.update_sharding == "scatter"
+        if self._scatter:
+            if aggregator != "mean":
+                raise ValueError(
+                    "update_sharding='scatter' shards the masked-MEAN "
+                    f"reduce; aggregator={aggregator!r} is a full-"
+                    "precision robust statistic over whole updates — "
+                    "drop one of the two")
+            if f.comm_dtype:
+                raise ValueError(
+                    "update_sharding='scatter' already restructures "
+                    "the aggregation wire path; comm_dtype applies to "
+                    "the plain masked-mean reduce only — drop one of "
+                    "the two")
+            if f.staleness_max > 0:
+                raise ValueError(
+                    "update_sharding='scatter' does not compose with "
+                    "staleness-aware aggregation (its decay-weighted "
+                    "sum runs on the unsharded tree) — drop one of "
+                    "the two")
+            if f.compact:
+                raise ValueError(
+                    "update_sharding='scatter' is a full-width sharded "
+                    "reduce; FederatedConfig.compact gathers m lanes "
+                    "and has no cross-worker collective to shard — "
+                    "drop one of the two")
+            if len(self.mesh.axis_names) != 1:
+                raise ValueError(
+                    "update_sharding='scatter' needs a flat 1-D worker "
+                    f"mesh (got {self.mesh.shape}); hybrid (hosts × "
+                    "ici) meshes keep the dense path")
+            from dopt.parallel.mesh import enable_latency_hiding_scheduler
+
+            # TPU-gated inside the helper via the env/libtpu probe —
+            # probing jax.default_backend() here would initialize the
+            # backend and make the flags unappliable (see gossip.py).
+            enable_latency_hiding_scheduler()
 
         # Staleness-aware aggregation (FederatedConfig.staleness_max):
         # instead of hard-dropping a deadline-missed straggler
@@ -258,6 +309,13 @@ class FederatedTrainer:
         self.params = shard_worker_tree(stacked, self.mesh)
         self.momentum = shard_worker_tree(
             jax.tree.map(np.zeros_like, stacked), self.mesh)
+        # Scatter-mode flat bucketing plan (static; compiled into the
+        # round program).
+        self._scatter_spec = (
+            make_update_shard_spec(
+                stacked, fold=self.mesh.size,
+                bucket_bytes=int(f.update_bucket_mb * (1 << 20)))
+            if self._scatter else None)
         # Staleness buffer: one pending (late) update slot per worker.
         self._stale_p = (
             shard_worker_tree(jax.tree.map(np.zeros_like, stacked),
@@ -348,6 +406,7 @@ class FederatedTrainer:
         # runs reproduce multi-device numerics).
         agg_mesh = self.mesh
         agg_comm = jnp.dtype(f.comm_dtype) if f.comm_dtype else None
+        scatter_spec = self._scatter_spec
         rho = cfg.optim.rho
         lr = cfg.optim.lr
         momentum_coef = cfg.optim.momentum
@@ -601,12 +660,15 @@ class FederatedTrainer:
                 new_stale = _where_mask(capture, p_t, stale_p)
                 stale_scr = (admit_w > 0).astype(jnp.float32) * (1.0 - fin_s)
             else:
-                if agg_robust is None:
+                if agg_robust is not None:
+                    new_theta = agg_robust(agg_in, agg_mask)
+                elif scatter_spec is not None:
+                    new_theta = masked_average_scatter(
+                        agg_in, agg_mask, agg_mesh, scatter_spec)
+                else:
                     new_theta = masked_average(agg_in, agg_mask,
                                                mesh=agg_mesh,
                                                comm_dtype=agg_comm)
-                else:
-                    new_theta = agg_robust(agg_in, agg_mask)
                 alive_any = agg_mask.sum() > 0
                 new_stale, stale_scr = None, None
             # A round with zero surviving (unscreened) updates leaves
@@ -1194,6 +1256,11 @@ class FederatedTrainer:
 
     def _use_compact(self, frac: float) -> bool:
         f = self.cfg.federated
+        if self._scatter:
+            # The sharded-update reduce is a full-width collective over
+            # the worker axis; compact's gathered-lane mean has nothing
+            # to shard (explicit compact=True was rejected at init).
+            return False
         if self._has_stale:
             # The staleness path needs full-width lanes: captured late
             # senders train outside the aggregating sample, and the

@@ -41,7 +41,9 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                pick_gather_chunks, prepare_holdout,
                                validate_optimizer)
 from dopt.models import build_model, count_params
-from dopt.parallel.collectives import mix_dense, mix_shifts, where_mask
+from dopt.parallel.collectives import (make_update_shard_spec, mix_dense,
+                                        mix_shifts, mix_update_scatter,
+                                        where_mask)
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
                                 worker_sharding)
@@ -547,9 +549,67 @@ class GossipTrainer:
 
         shift_ids = self._shift_ids
 
+        # Sharded weight-update/consensus hot path (ISSUE 5 tentpole):
+        # update_sharding="scatter" flattens θ into size-bounded buckets
+        # and runs the mixing as reduce-scatter partial contractions
+        # (dense) or the sharded circulant contraction over the same
+        # buckets (shift), with per-bucket collectives the XLA
+        # latency-hiding scheduler can overlap with compute.  "off"
+        # keeps every pre-change program byte-for-byte (python gating).
+        if g.update_sharding not in ("off", "scatter"):
+            raise ValueError(
+                f"unknown update_sharding {g.update_sharding!r}; "
+                "one of off|scatter")
+        self._scatter_spec = None
+        if g.update_sharding == "scatter":
+            if g.algorithm not in ("dsgd", "fedlcon", "gossip"):
+                raise ValueError(
+                    "update_sharding='scatter' shards the consensus "
+                    "mix; algorithm "
+                    f"{g.algorithm!r} has no dense mixing step to "
+                    "shard (dsgd|fedlcon|gossip)")
+            if robust_active:
+                raise ValueError(
+                    "update_sharding='scatter' does not compose with "
+                    "the robust layer (corrupt faults / clip_radius / "
+                    "quarantine run full-precision pairwise mixing on "
+                    "the unsharded tree) — drop one of the two")
+            if self._link_mode:
+                raise ValueError(
+                    "update_sharding='scatter' does not compose with "
+                    "link faults / push-sum (the per-staleness "
+                    "[D+1, n, n] contraction carries its own buffers) "
+                    "— drop one of the two")
+            if g.comm_dtype:
+                raise ValueError(
+                    "update_sharding='scatter' already restructures "
+                    "the wire path; comm_dtype compression applies to "
+                    "the plain collectives only — drop one of the two")
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "update_sharding='scatter' needs a flat 1-D worker "
+                    f"mesh (got {mesh.shape}); hybrid (hosts × ici) "
+                    "meshes keep the dense path")
+            from dopt.parallel.mesh import enable_latency_hiding_scheduler
+
+            # Best-effort: on TPU the overlap needs the scheduler
+            # flags in XLA_FLAGS before backend init (bench.py sets
+            # them up front; this warns when too late).  The helper
+            # gates on the env/libtpu probe itself — calling
+            # jax.default_backend() here would INITIALIZE the backend
+            # and guarantee the too-late path.
+            enable_latency_hiding_scheduler()
+            self._scatter_spec = make_update_shard_spec(
+                stacked, fold=mesh.size,
+                bucket_bytes=int(g.update_bucket_mb * (1 << 20)))
+        scatter_spec = self._scatter_spec
+
         def mix_once(x, arg):
             """One consensus sweep; ``arg`` is the [n, n] matrix (dense)
             or the [k, n] coefficient table (shift) for the round."""
+            if scatter_spec is not None:
+                return mix_update_scatter(x, arg, mesh, scatter_spec,
+                                          shift_ids=shift_ids)
             if shift_ids is not None:
                 return mix_shifts(x, shift_ids, arg, mesh, comm_dtype)
             return mix_dense(x, arg, mesh, comm_dtype)

@@ -81,13 +81,20 @@ def prepare_holdout(cfg, index_matrix, mesh, *, batch_size):
 
 def _apply_update(p, m, g, *, lr, momentum, update_impl):
     """Dispatch the momentum-SGD update: 'jnp' (tree.map two-liner) or
-    'pallas' (fused single-pass kernel, dopt.ops.fused_update)."""
-    if update_impl == "pallas":
-        from dopt.ops import fused_sgd_momentum_tree
+    'pallas' (fused single-pass kernel, dopt.ops.fused_update).
 
-        return fused_sgd_momentum_tree(p, m, g, lr=lr, mu=momentum)
-    p, st = sgd_step(p, SGDState(m), g, lr=lr, momentum=momentum)
-    return p, st.momentum
+    The ``dopt_update`` named scope tags the update's HLO ops so the
+    profiler can attribute the round's device time into conv / mixing-
+    comm / update fractions (``dopt.utils.profiling.classify_phase``,
+    surfaced in bench.py's JSON line) — metadata only, numerics and
+    compiled programs are unchanged."""
+    with jax.named_scope("dopt_update"):
+        if update_impl == "pallas":
+            from dopt.ops import fused_sgd_momentum_tree
+
+            return fused_sgd_momentum_tree(p, m, g, lr=lr, mu=momentum)
+        p, st = sgd_step(p, SGDState(m), g, lr=lr, momentum=momentum)
+        return p, st.momentum
 
 
 def _make_step_core(apply_fn, *, lr, momentum, algorithm, rho, l2,

@@ -111,9 +111,12 @@ def fused_sgd_momentum_tree(params, momentum, grads, *, lr: float, mu: float,
     p_leaves, treedef = jax.tree.flatten(params)
     m_leaves = treedef.flatten_up_to(momentum)
     g_leaves = treedef.flatten_up_to(grads)
-    for p, m, g in zip(p_leaves, m_leaves, g_leaves):
-        np_, nm_ = fused_sgd_momentum(p, m, g, lr=lr, mu=mu,
-                                      interpret=interpret)
-        new_p.append(np_)
-        new_m.append(nm_)
+    # dopt_update scope: phase attribution for the profiler's
+    # conv/comm/update split (dopt.utils.profiling.classify_phase).
+    with jax.named_scope("dopt_update"):
+        for p, m, g in zip(p_leaves, m_leaves, g_leaves):
+            np_, nm_ = fused_sgd_momentum(p, m, g, lr=lr, mu=mu,
+                                          interpret=interpret)
+            new_p.append(np_)
+            new_m.append(nm_)
     return treedef.unflatten(new_p), treedef.unflatten(new_m)

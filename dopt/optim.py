@@ -39,9 +39,15 @@ def init_sgd(params) -> SGDState:
 
 
 def sgd_step(params, state: SGDState, grads, *, lr: float, momentum: float):
-    """One torch-semantics SGD step. Returns (new_params, new_state)."""
-    new_buf = jax.tree.map(lambda m, g: momentum * m + g, state.momentum, grads)
-    new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_buf)
+    """One torch-semantics SGD step. Returns (new_params, new_state).
+
+    Tagged ``dopt_update`` so profiler traces attribute the optimizer
+    phase separately from conv compute and mixing collectives
+    (``dopt.utils.profiling.classify_phase``)."""
+    with jax.named_scope("dopt_update"):
+        new_buf = jax.tree.map(lambda m, g: momentum * m + g,
+                               state.momentum, grads)
+        new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_buf)
     return new_params, SGDState(momentum=new_buf)
 
 

@@ -28,6 +28,9 @@ mask instead of Python-side client selection.
 
 from __future__ import annotations
 
+import dataclasses
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,7 +72,10 @@ def mix_dense(stacked, w_matrix, mesh: Mesh | None = None,
             y = jax.lax.with_sharding_constraint(y, worker_sharding(mesh))
         return y
 
-    return jax.tree.map(mix_leaf, stacked)
+    # dopt_mix scope: phase attribution for the profiler's
+    # conv/comm/update split (dopt.utils.profiling.classify_phase).
+    with jax.named_scope("dopt_mix"):
+        return jax.tree.map(mix_leaf, stacked)
 
 
 def _mix_dense_compressed(stacked, w, mesh: Mesh, comm_dtype):
@@ -228,7 +234,8 @@ def mix_shifts(stacked, shift_ids, coeff_table, mesh: Mesh, comm_dtype=None):
         )
         return fn(coeff_table, x)
 
-    return jax.tree.map(mix_leaf, stacked)
+    with jax.named_scope("dopt_mix"):
+        return jax.tree.map(mix_leaf, stacked)
 
 
 def mix_shifts_shardmap(stacked, shifts, mesh: Mesh, comm_dtype=None):
@@ -273,7 +280,8 @@ def masked_average(stacked, mask, mesh: Mesh | None = None, comm_dtype=None):
         mm = m.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
         return (x * mm).sum(axis=0) / denom.astype(x.dtype)
 
-    return jax.tree.map(avg_leaf, stacked)
+    with jax.named_scope("dopt_mix"):
+        return jax.tree.map(avg_leaf, stacked)
 
 
 def _masked_average_compressed(stacked, m, denom, mesh: Mesh, comm_dtype):
@@ -304,6 +312,260 @@ def _masked_average_compressed(stacked, m, denom, mesh: Mesh, comm_dtype):
         return fn(m, x)
 
     return jax.tree.map(avg_leaf, stacked)
+
+
+# ---------------------------------------------------------------------
+# Sharded weight-update / consensus hot path (update_sharding="scatter")
+# ---------------------------------------------------------------------
+# "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+# Training" (Xu et al., arXiv:2004.13336) applied to the consensus
+# round: instead of every lane's device redundantly materialising and
+# post-processing the FULL |θ| during the mixing/aggregation phase, the
+# parameter tree is flattened once into size-bounded f32/bf16 BUCKETS
+# ([W, Fb] slabs), the cross-worker contraction runs as per-device
+# partial sums + ``psum_scatter`` (each device produces only the 1/D
+# shard it owns), the remaining update math runs on that shard, and ONE
+# all-gather restores the full view.  Issuing the collectives bucket by
+# bucket is what lets XLA's latency-hiding scheduler overlap bucket b's
+# wire time with bucket b+1's compute
+# (``dopt.parallel.mesh.enable_latency_hiding_scheduler``).
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateShardSpec:
+    """Static flattening/bucketing plan for a stacked [W, ...] pytree.
+
+    Built once at trainer construction (``make_update_shard_spec``);
+    everything here is static python data so the bucket slicing compiles
+    into the round program.  ``bounds`` are fold-aligned offsets into
+    the zero-padded flat axis — every bucket's length divides evenly by
+    ``fold`` (the mesh device count), which is what lets
+    ``psum_scatter``/``all_gather`` split each bucket exactly."""
+
+    treedef: object
+    shapes: tuple[tuple[int, ...], ...]   # per-leaf shapes sans worker axis
+    sizes: tuple[int, ...]
+    dtype: object
+    fold: int
+    flat: int      # true flattened per-worker element count
+    padded: int    # flat rounded up to a fold multiple
+    bounds: tuple[int, ...]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.bounds) - 1
+
+
+def make_update_shard_spec(tree, *, fold: int,
+                           bucket_bytes: int = 4 << 20) -> UpdateShardSpec:
+    """Plan the flat bucketing of ``tree`` (a stacked [W, ...] pytree).
+
+    ``fold`` is the shard count (mesh size) every bucket must divide by;
+    ``bucket_bytes`` bounds each bucket's per-worker payload so the
+    mixing collectives are issued as a pipeline of comparable chunks
+    rather than one monolithic transfer.  All leaves must share one
+    dtype (the engines store params/momentum at a single param_dtype) —
+    mixed dtypes would force a lossy common cast, so they are rejected."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        raise ValueError("cannot bucket an empty pytree")
+    dtypes = {jnp.dtype(x.dtype) for x in leaves}
+    if len(dtypes) != 1:
+        raise ValueError(
+            f"update sharding needs a uniform leaf dtype, got {dtypes}")
+    dtype = dtypes.pop()
+    shapes = tuple(tuple(x.shape[1:]) for x in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    flat = int(sum(sizes))
+    fold = max(int(fold), 1)
+    padded = -(-flat // fold) * fold
+    per_elem = dtype.itemsize
+    step = max(int(bucket_bytes) // per_elem // fold, 1) * fold
+    bounds = tuple(range(0, padded, step)) + (padded,)
+    return UpdateShardSpec(treedef=treedef, shapes=shapes, sizes=sizes,
+                           dtype=dtype, fold=fold, flat=flat,
+                           padded=padded, bounds=bounds)
+
+
+def stacked_to_buckets(tree, spec: UpdateShardSpec) -> list:
+    """Flatten a stacked [W, ...] pytree into the spec's [W, Fb] bucket
+    slabs (zero-padded tail).  The inverse is ``buckets_to_stacked`` —
+    the round trip is bit-exact (pure reshape/concat/slice)."""
+    leaves = jax.tree.leaves(tree)
+    w = leaves[0].shape[0]
+    flat = jnp.concatenate([x.reshape(w, -1) for x in leaves], axis=1)
+    if spec.padded != spec.flat:
+        flat = jnp.pad(flat, ((0, 0), (0, spec.padded - spec.flat)))
+    return [flat[:, a:b] for a, b in zip(spec.bounds, spec.bounds[1:])]
+
+
+def _flat_to_tree(flat, spec: UpdateShardSpec, lead: tuple[int, ...]):
+    out, off = [], 0
+    for shape, size in zip(spec.shapes, spec.sizes):
+        out.append(flat[..., off:off + size].reshape(lead + shape))
+        off += size
+    return spec.treedef.unflatten(out)
+
+
+def buckets_to_stacked(buckets: list, spec: UpdateShardSpec):
+    flat = jnp.concatenate(buckets, axis=1)[:, :spec.flat]
+    return _flat_to_tree(flat, spec, (flat.shape[0],))
+
+
+def buckets_to_tree(buckets: list, spec: UpdateShardSpec):
+    """Single (no worker axis) variant: [Fb] buckets → the θ tree."""
+    flat = jnp.concatenate(buckets, axis=0)[:spec.flat]
+    return _flat_to_tree(flat, spec, ())
+
+
+def _require_flat_mesh(mesh: Mesh | None, what: str) -> str:
+    if mesh is None:
+        raise ValueError(f"{what} requires a mesh")
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"{what} runs psum_scatter over ONE worker axis; hybrid "
+            f"(hosts × ici) meshes are not supported — got {mesh.shape}")
+    return mesh.axis_names[0]
+
+
+def mix_dense_scatter(buckets, w_matrix, mesh: Mesh):
+    """Reduce-scatter formulation of ``mix_dense`` over flat buckets:
+    each device contracts the mixing matrix's columns for ITS lanes
+    against its local [L, Fb] slab (a partial sum of the true output for
+    every worker), and one ``psum_scatter`` both completes the sum and
+    hands each device exactly its own lanes' mixed rows — no device
+    ever materialises the [n, Fb] gathered fleet state, and the
+    per-bucket issue order gives the latency-hiding scheduler chunks to
+    overlap.
+
+    Numerics: the mixing matrix and the accumulation stay FLOAT32
+    regardless of the leaf dtype.  For f32 trees that differs from
+    ``mix_dense`` only by summation association (the allclose-pinned
+    parity contract); for bf16 trees it is strictly MORE precise than
+    the dense path, which casts the matrix to bf16 and contracts at the
+    leaf dtype — so bf16 scatter-vs-dense deltas include that matrix
+    quantization (~1e-3/row), not just reassociation."""
+    ax = _require_flat_mesh(mesh, "update_sharding='scatter'")
+    w = jnp.asarray(w_matrix, dtype=jnp.float32)
+
+    def per_device(w_cols, x):
+        # w_cols: [n, L] — this device's lanes' columns of W;
+        # x: [L, Fb] local lane slab.
+        part = jnp.tensordot(w_cols, x.astype(jnp.float32),
+                             axes=[[1], [0]])          # [n, Fb] partial
+        own = jax.lax.psum_scatter(part, ax, scatter_dimension=0,
+                                   tiled=True)         # [L, Fb] mine
+        return own.astype(x.dtype)
+
+    fn = compat_shard_map(per_device, mesh=mesh,
+                          in_specs=(P(None, ax), P(ax)),
+                          out_specs=P(ax))
+    with jax.named_scope("dopt_mix"):
+        return [fn(w, b) for b in buckets]
+
+
+def mix_update_scatter(stacked, arg, mesh: Mesh, spec: UpdateShardSpec,
+                       shift_ids=None):
+    """The engine-facing scatter-mode consensus step: flatten the
+    stacked tree into the spec's buckets, mix every bucket (dense
+    reduce-scatter, or the sharded circulant contraction when the
+    schedule decomposed into shifts — ``mix_shifts`` over flat buckets
+    ships the SAME lane unions per rotation, just as size-bounded flat
+    chunks instead of per-leaf payloads), and restore the tree."""
+    buckets = stacked_to_buckets(stacked, spec)
+    if shift_ids is not None:
+        with jax.named_scope("dopt_mix"):
+            mixed = mix_shifts(buckets, shift_ids, arg, mesh)
+    else:
+        mixed = mix_dense_scatter(buckets, arg, mesh)
+    return buckets_to_stacked(mixed, spec)
+
+
+def masked_average_scatter(stacked, mask, mesh: Mesh,
+                           spec: UpdateShardSpec):
+    """Sharded-update formulation of ``masked_average`` (Xu et al.,
+    arXiv:2004.13336): each device reduces its local lanes' masked
+    partial sum per bucket, ``psum_scatter`` leaves each device owning
+    a 1/D shard of the flat sum, the aggregation update (the divide)
+    runs on that shard only, and ONE tiled all-gather re-forms the
+    replicated θ — instead of every device redundantly computing the
+    full |θ| average.  Returns the unstacked θ tree."""
+    ax = _require_flat_mesh(mesh, "update_sharding='scatter'")
+    m = jnp.asarray(mask, dtype=jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    buckets = stacked_to_buckets(stacked, spec)
+
+    def per_device(mask_l, x):
+        mm = mask_l.reshape((-1,) + (1,) * (x.ndim - 1))
+        part = (x.astype(jnp.float32) * mm).sum(axis=0)     # [Fb] partial
+        shard = jax.lax.psum_scatter(part, ax, scatter_dimension=0,
+                                     tiled=True)            # [Fb/D] mine
+        with jax.named_scope("dopt_update"):
+            upd = (shard / denom).astype(x.dtype)           # 1/D update
+        return jax.lax.all_gather(upd, ax, axis=0, tiled=True)
+
+    # all_gather of identical shards IS replicated but cannot be
+    # statically proven so — skip the varying-axes check, mirroring
+    # _masked_average_compressed.
+    fn = compat_shard_map(per_device, mesh=mesh,
+                          in_specs=(P(ax), P(ax)), out_specs=P(),
+                          check=False)
+    with jax.named_scope("dopt_mix"):
+        out = [fn(m, b) for b in buckets]
+    return buckets_to_tree(out, spec)
+
+
+# ---------------------------------------------------------------------
+# Compiled-HLO collective byte accounting
+# ---------------------------------------------------------------------
+
+_HLO_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+              "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8,
+              "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_HLO_COLLECTIVES = ("all-gather", "all-reduce", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _HLO_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _HLO_BYTES[dtype]
+    return total
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict[str, int]:
+    """Count the result-buffer bytes of every cross-device collective in
+    a compiled HLO dump (``jit(fn).lower(...).compile().as_text()``):
+    ``{op_kind: bytes, ..., "total": bytes}``.
+
+    This is the measured basis for comm-volume claims — e.g. the folded
+    shift path's "2 lane-shards per device vs the dense all_gather's
+    n − L" (``tests/test_collectives.py`` pins it against the compiled
+    programs, not the docstring).  Result-buffer bytes upper-bound wire
+    bytes proportionally (an all-gather's result includes the local
+    shard), which cancels in path-vs-path comparisons.  Async pairs
+    (``*-start``/``*-done``) are counted once, at the start op."""
+    out: dict[str, int] = {k: 0 for k in _HLO_COLLECTIVES}
+    for line in hlo_text.splitlines():
+        if "=" not in line:
+            continue
+        rhs = line.partition("=")[2].strip()
+        for kind in _HLO_COLLECTIVES:
+            m = re.search(rf"(^|\s){re.escape(kind)}(-start)?\(", rhs)
+            if m:
+                out[kind] += _shape_bytes(rhs[:m.start()])
+                break
+    out["total"] = sum(out[k] for k in _HLO_COLLECTIVES)
+    return out
 
 
 def broadcast_to_workers(tree, num_workers: int):

@@ -36,6 +36,22 @@ HOST_AXIS = "hosts"   # slow axis: crosses DCN on a real multi-slice job
 ICI_AXIS = "ici"      # fast axis: stays on-slice
 
 
+def _distributed_initialized() -> bool:
+    """Whether ``jax.distributed`` is already wired, across jax
+    versions: new jax exposes ``jax.distributed.is_initialized``; 0.4.x
+    only carries the module-level client state.  Double-initialising
+    raises, so this probe gates ``initialize_distributed``."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -57,7 +73,7 @@ def initialize_distributed(
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         return False
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return True   # a launcher/framework already wired the runtime
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -19,6 +19,7 @@ the function's result via ``block_until_ready``.
 from __future__ import annotations
 
 import contextlib
+import re
 import time
 from collections import defaultdict
 from typing import Any, Iterator
@@ -81,6 +82,66 @@ def trace(log_dir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+# ---------------------------------------------------------------------
+# Round-phase attribution: conv / mixing-comm / update / other
+# ---------------------------------------------------------------------
+# The round's device time decomposes into the conv stack (the actual
+# training math), the consensus/aggregation phase (collectives + the
+# mixing contraction, tagged ``dopt_mix`` at the source), and the
+# optimizer/weight-update phase (tagged ``dopt_update``).  bench.py
+# surfaces these fractions in its JSON line so "conv fraction >= X%"
+# claims are measured from the trace, not guessed.
+
+_COMM_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                 "collective-permute", "all-to-all", "allreduce",
+                 "allgather", "reducescatter", "collectivepermute",
+                 "alltoall")
+
+# "conv" but NOT "convert": dtype-conversion ops are everywhere on the
+# bf16 fast leg and must not inflate the conv fraction (the acceptance
+# metric) with cast overhead.
+_CONV_RE = re.compile(r"conv(?!ert)")
+
+PHASES = ("conv", "comm", "update", "other")
+
+
+def classify_phase(op_type: str | None, operation: str | None = None) -> str:
+    """Classify one profiled op into conv | comm | update | other.
+
+    ``op_type`` is the framework-op-stats category, ``operation`` the
+    op's name (which carries the jax name stack, so the engines'
+    ``dopt_update``/``dopt_mix`` named scopes land here).  Precedence:
+    the update tag wins (a sharded update nests inside the mix scope),
+    then cross-device collectives and anything in the mixing scope
+    (the consensus contraction is comm-phase work even when it lowers
+    to a local gemm), then convolutions."""
+    t = (op_type or "").lower()
+    n = (operation or "").lower()
+    if "dopt_update" in n:
+        return "update"
+    if any(k in t for k in _COMM_MARKERS) or any(k in n for k in _COMM_MARKERS):
+        return "comm"
+    if "dopt_mix" in n:
+        return "comm"
+    if _CONV_RE.search(t) or _CONV_RE.search(n):
+        return "conv"
+    return "other"
+
+
+def phase_totals(rows) -> dict[str, Any]:
+    """Reduce ``(op_type, operation, self_time_us)`` rows to per-phase
+    totals + fractions: ``{conv_us, ..., conv_fraction, ...}``.  Pure
+    (no profiler dependency) so the classification is unit-testable."""
+    tot = {k: 0.0 for k in PHASES}
+    for op_type, operation, self_us in rows:
+        tot[classify_phase(op_type, operation)] += float(self_us)
+    dev = sum(tot.values())
+    out: dict[str, Any] = {f"{k}_us": round(v, 1) for k, v in tot.items()}
+    for k, v in tot.items():
+        out[f"{k}_fraction"] = round(v / dev, 4) if dev > 0 else 0.0
+    return out
+
+
 def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
     """Reduce a captured xplane to op-level self times (the shared
     reduction behind ``scripts/trace_roofline.py`` and ``bench.py``'s
@@ -88,7 +149,8 @@ def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
 
     Returns ``{device_self_time_us, host_self_time_us,
     device_categories: [{op_type, self_time_us, pct_of_device}],
-    top_device_ops: [...]}``.
+    device_phases: {conv_us, comm_us, update_us, other_us,
+    *_fraction}, top_device_ops: [...]}``.
     """
     import glob
     import json
@@ -113,6 +175,7 @@ def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
     by_cat: dict[str, float] = {}
     device_total = host_total = 0.0
     ops = []
+    phase_rows = []
     for row in table.get("rows", []):
         side = val(row, "host_or_device")
         self_us = float(val(row, "total_self_time") or 0.0)
@@ -120,6 +183,7 @@ def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
         if side == "Device":
             device_total += self_us
             by_cat[cat] = by_cat.get(cat, 0.0) + self_us
+            phase_rows.append((cat, val(row, "operation"), self_us))
             ops.append({
                 "op_type": cat,
                 "operation": val(row, "operation"),
@@ -138,19 +202,28 @@ def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
              "pct_of_device": round(100.0 * v / max(device_total, 1e-9), 2)}
             for k, v in cat_rows
         ],
+        "device_phases": phase_totals(phase_rows),
         "top_device_ops": ops[:20],
     }
 
 
-def device_time_of(fn, *, trace_prefix: str = "dopt-devtime-") -> float:
-    """Run ``fn()`` under a profiler trace and return the device self
-    time in microseconds — the tunnel-immune basis for rounds/sec."""
+def device_stats_of(fn, *, trace_prefix: str = "dopt-devtime-") -> dict:
+    """Run ``fn()`` under a profiler trace and return the full
+    ``xplane_op_stats`` reduction (device self time + the
+    conv/comm/update phase split)."""
     import tempfile
 
     with tempfile.TemporaryDirectory(prefix=trace_prefix) as td:
         with trace(td):
             fn()
-        return xplane_op_stats(td)["device_self_time_us"]
+        return xplane_op_stats(td)
+
+
+def device_time_of(fn, *, trace_prefix: str = "dopt-devtime-") -> float:
+    """Run ``fn()`` under a profiler trace and return the device self
+    time in microseconds — the tunnel-immune basis for rounds/sec."""
+    return device_stats_of(fn, trace_prefix=trace_prefix)[
+        "device_self_time_us"]
 
 
 # ---------------------------------------------------------------------

@@ -94,15 +94,15 @@ def parent_main(args) -> int:
     diag = ""
     for attempt in range(3):
         rc, diag = _parent_attempt(args)
-        if rc != 3:  # 3 = coordinator bind failure (retryable)
+        if rc != 3:  # 3 = retryable (port race / gloo transport race)
             return rc
-        print(f"coordinator port race (attempt {attempt + 1}/3), retrying "
-              "with a fresh port", file=sys.stderr)
+        print(f"retryable launch failure (attempt {attempt + 1}/3), "
+              "respawning with a fresh coordinator port", file=sys.stderr)
     # Out of retries: surface the last attempt's child output so a
-    # non-port failure that happened to match the bind heuristic is
+    # non-retryable failure that happened to match the heuristics is
     # still diagnosable from the logs.
     sys.stderr.write(f"--- last attempt child output ---\n{diag}\n")
-    print("FAIL: coordinator could not bind after 3 attempts",
+    print("FAIL: retryable launch failure persisted after 3 attempts",
           file=sys.stderr)
     return 1
 
@@ -153,6 +153,16 @@ def _parent_attempt(args) -> tuple[int, str]:
             if "failed to bind" in low or "address already in use" in low:
                 # Retryable: another process grabbed the probed port.
                 # The caller prints this output if retries run out.
+                return 3, out
+            if "op.preamble.length" in low:
+                # Retryable: gloo's tcp transport occasionally
+                # interleaves two collectives' messages on one pair
+                # under host load (preamble/buffer length mismatch →
+                # SIGABRT).  A transport-layer race, not a dopt bug —
+                # respawn the whole attempt on a fresh coordinator.
+                # (Matched on the specific signature only: a generic
+                # 'gloo' match would retry — and mask — deterministic
+                # failures whose logs merely mention the transport.)
                 return 3, out
             sys.stderr.write(f"--- child {i} (rc={rc}) output ---\n{out}\n")
             print(f"FAIL: child {i} rc={rc} ok={bool(marks)}", file=sys.stderr)

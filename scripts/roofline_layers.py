@@ -158,6 +158,52 @@ def bench_layer(h, cin, cout, k, stride, *, workers=W, lane_batch=B,
     return fl, fl / t_g / 1e12, fl / t_s / 1e12
 
 
+def bench_update(params_total, iters, *, lr=0.01, mu=0.5):
+    """Device time per momentum-SGD update of a ``params_total``-element
+    fleet parameter vector (the weight-update phase: 3 reads, 2 writes,
+    zero FLOP reuse — pure HBM bandwidth), measured as one jitted scan
+    of DEPENDENT steps exactly like ``measure``.  This is the
+    non-conv round fraction ISSUE 5 shards away (update_sharding=
+    "scatter" runs it on 1/D of the flat tree), committed here so
+    regressions in the update share are attributable from the
+    artifact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    p = jnp.asarray(rng.normal(size=params_total).astype(np.float32))
+    m = jnp.zeros_like(p)
+    g = jnp.asarray(rng.normal(size=params_total).astype(np.float32))
+
+    def run_impl(p0, m0, gg):
+        def body(carry, _):
+            p_, m_ = carry
+            buf = mu * m_ + gg
+            return (p_ - lr * buf, buf), ()
+
+        return jax.lax.scan(body, (p0, m0), None, length=iters)[0]
+
+    run = jax.jit(run_impl)
+    jax.block_until_ready(run(p, m, g))
+    from dopt.utils.profiling import device_time_of
+
+    def blk():
+        jax.block_until_ready(run(p, m, g))
+
+    return device_time_of(blk) / 1e6 / iters
+
+
+def fleet_param_count(geom) -> int:
+    """Conv-layer fleet parameter count for a preset's geometry table
+    (weights + biases, × workers).  Exact for the headline Model1
+    (1.66M × 6); for baseline5 it covers the conv stack the table
+    describes (the norm/fc tail is <1% of the ResNet tree)."""
+    per_worker = sum(count * (k * k * cin * cout + cout)
+                     for _, count, _, cin, cout, k, _ in geom["layers"])
+    return geom["workers"] * per_worker
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -226,6 +272,32 @@ def main() -> int:
                   f"{tf_g:.1f} single {tf_s:.1f} "
                   f"(ratio {tf_g/tf_s:.2f})", flush=True)
 
+    # Update-phase share (ISSUE 5 satellite): the per-step weight
+    # update over the full fleet tree, alongside the per-layer conv
+    # compute — the committed artifact that makes regressions in the
+    # NON-conv round fraction attributable.  Per-step share equals
+    # per-round share (both scale with step count).
+    fleet_params = fleet_param_count(geom)
+    upd_s = bench_update(fleet_params, args.iters)
+    conv_s = sum(r["train_flops_fleet"] * r["count"]
+                 / (r["grouped_tflops"] * 1e12) for r in rows)
+    update_phase = {
+        "fleet_params": fleet_params,
+        "update_us_per_step": round(upd_s * 1e6, 2),
+        "conv_us_per_step": round(conv_s * 1e6, 2),
+        "update_share_of_step": round(upd_s / (upd_s + conv_s), 4),
+        "update_gbps": round(5 * 4 * fleet_params / upd_s / 1e9, 1),
+        "note": ("momentum-SGD update of the fleet tree (3 reads + 2 "
+                 "writes per element, dependent-step scan, profiler "
+                 "device self-time) vs the conv stack's per-step time "
+                 "from the table above; update_sharding='scatter' "
+                 "divides the update work by the mesh size"),
+    }
+    print(f"update phase: {upd_s*1e6:.1f} us/step over "
+          f"{fleet_params/1e6:.2f}M params "
+          f"({update_phase['update_share_of_step']*100:.1f}% of "
+          f"conv+update step time)", flush=True)
+
     payload = {
         "suite": f"roofline_layers_{args.preset}",
         "device": str(jax.devices()[0]),
@@ -242,6 +314,7 @@ def main() -> int:
                  "~1.4x; the grouped/single ratio cancels that."),
         "layers": rows,
         "summary": summary,
+        "update_phase": update_phase,
         "double_lane_batch_probe": probes,
     }
     out = Path(out_path)

@@ -1,0 +1,371 @@
+"""update_sharding="scatter": the sharded consensus/weight-update path.
+
+Contract (ISSUE 5 tentpole):
+
+* ``off`` (default) touches ZERO code paths — python-level gating, so
+  baseline1/baseline3 programs stay byte-identical to pre-change.
+* ``scatter`` agrees with the dense path to f32 summation order
+  (allclose, NOT bit-equal: reduce-scatter reassociates the sum), and
+  scatter-vs-scatter is bit-reproducible, blocked-exact and
+  resume-exact.
+* Ineligible compositions (robust layer, link faults/push-sum, choco,
+  comm_dtype, staleness, compact, hybrid meshes) are rejected LOUDLY
+  at trainer construction — never silently run a different experiment.
+
+Collective-level tests run on the 8-device virtual CPU mesh; engine
+tests use the tiny synthetic MLP configs from ``test_engine``.  The
+gossip parity/repro/blocked test is the tier-1 scatter signal; the
+resume-exactness, faults-composition and federated engine tests are
+marked ``slow`` (they run in the unfiltered suite) to keep the tier-1
+sweep inside its 870s wall-clock budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dopt.parallel.collectives import (buckets_to_stacked, buckets_to_tree,
+                                        hlo_collective_bytes,
+                                        make_update_shard_spec,
+                                        masked_average, masked_average_scatter,
+                                        mix_dense, mix_shifts,
+                                        mix_update_scatter, shift_comm_lanes,
+                                        stacked_to_buckets)
+from dopt.parallel.mesh import make_mesh, shard_worker_tree
+from dopt.topology import build_mixing_matrices, coeffs_for_matrix
+
+from tests.test_engine import _fed_cfg, _gossip_cfg
+
+
+def _tree(w, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": jnp.asarray(rng.normal(size=(w, 5, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(w, 7)).astype(np.float32)),
+    }
+
+
+def _flat(tree):
+    return np.concatenate([np.ravel(np.asarray(x))
+                           for x in jax.tree.leaves(jax.device_get(tree))])
+
+
+# ---------------------------------------------------------------------
+# Bucketing spec
+# ---------------------------------------------------------------------
+
+def test_spec_roundtrip_bit_exact():
+    tree = _tree(8)
+    # Tiny bucket budget forces multiple buckets; fold=8 forces padding
+    # (22 elements → 24).
+    spec = make_update_shard_spec(tree, fold=8, bucket_bytes=64)
+    assert spec.num_buckets > 1
+    assert spec.padded % spec.fold == 0
+    sizes = [b - a for a, b in zip(spec.bounds, spec.bounds[1:])]
+    assert all(s % spec.fold == 0 and s > 0 for s in sizes)
+    buckets = stacked_to_buckets(tree, spec)
+    assert [b.shape[1] for b in buckets] == sizes
+    back = buckets_to_stacked(buckets, spec)
+    for k in tree:
+        assert np.array_equal(np.asarray(tree[k]), np.asarray(back[k]))
+    # Single-tree (theta) inverse: feed per-worker rows of a known tree.
+    one = {k: v[3] for k, v in tree.items()}
+    ob = [b[3] for b in stacked_to_buckets(tree, spec)]
+    back1 = buckets_to_tree(ob, spec)
+    for k in one:
+        assert np.array_equal(np.asarray(one[k]), np.asarray(back1[k]))
+
+
+def test_spec_rejects_mixed_dtypes():
+    tree = {"a": jnp.zeros((4, 3), jnp.float32),
+            "b": jnp.zeros((4, 3), jnp.bfloat16)}
+    with pytest.raises(ValueError, match="uniform leaf dtype"):
+        make_update_shard_spec(tree, fold=4)
+
+
+# ---------------------------------------------------------------------
+# Scatter collectives vs ground truth
+# ---------------------------------------------------------------------
+
+def _np_mix(w_matrix, tree):
+    return {k: np.tensordot(w_matrix, np.asarray(v),
+                            axes=[[1], [0]]).astype(np.float32)
+            for k, v in tree.items()}
+
+
+def test_mix_scatter_matches_numpy(devices):
+    mesh = make_mesh(8)
+    mm = build_mixing_matrices("circle", "metropolis", 8)
+    tree = shard_worker_tree(_tree(8), mesh)
+    spec = make_update_shard_spec(tree, fold=mesh.size, bucket_bytes=64)
+    want = _np_mix(mm.matrices[0], tree)
+    # Dense reduce-scatter formulation.
+    out = jax.jit(lambda t, w: mix_update_scatter(t, w, mesh, spec))(
+        tree, mm.matrices[0])
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), want[k],
+                                   rtol=2e-5, atol=1e-6)
+    # Sharded circulant contraction (the ppermute path over buckets).
+    ids = (0, 1, 7)
+    coeffs = coeffs_for_matrix(mm.matrices[0], ids)
+    out2 = jax.jit(lambda t, c: mix_update_scatter(t, c, mesh, spec,
+                                                   shift_ids=ids))(
+        tree, coeffs)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out2[k]), want[k],
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_masked_average_scatter_matches_dense(devices):
+    mesh = make_mesh(8)
+    tree = shard_worker_tree(_tree(8), mesh)
+    spec = make_update_shard_spec(tree, fold=mesh.size, bucket_bytes=64)
+    mask = np.array([1, 0, 1, 1, 0, 1, 1, 1], np.float32)
+    got = jax.jit(lambda t: masked_average_scatter(t, mask, mesh, spec))(tree)
+    want = masked_average(tree, mask)
+    for k in tree:
+        assert got[k].shape == tree[k].shape[1:]
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_scatter_requires_flat_mesh(devices):
+    from dopt.parallel.multihost import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(2)
+    tree = shard_worker_tree(_tree(8), mesh)
+    spec = make_update_shard_spec(tree, fold=8)
+    with pytest.raises(ValueError, match="hybrid"):
+        masked_average_scatter(tree, np.ones(8, np.float32), mesh, spec)
+
+
+# ---------------------------------------------------------------------
+# Compiled-HLO collective byte accounting (VERDICT round-5 open ask:
+# the folded-lane ICI byte-savings claim, counted from the compiled
+# program instead of asserted in a docstring)
+# ---------------------------------------------------------------------
+
+def test_hlo_collective_bytes_parser():
+    txt = """
+  %x = f32[4,7]{1,0} add(f32[4,7] %a, f32[4,7] %b)
+  %ag = f32[32,7]{1,0} all-gather(f32[4,7]{1,0} %x), dimensions={0}
+  %cp = f32[1,7]{1,0} collective-permute(f32[1,7]{1,0} %y), source_target_pairs={{0,1}}
+  %ags = (f32[4,7], f32[32,7]) all-gather-start(f32[4,7] %x)
+  %agd = f32[32,7]{1,0} all-gather-done((f32[4,7], f32[32,7]) %ags)
+"""
+    got = hlo_collective_bytes(txt)
+    # plain all-gather result 32*7*4 = 896; the start op counts its
+    # (operand, result) tuple once (1008) and the done op not at all.
+    assert got["all-gather"] == 896 + (112 + 896)
+    assert got["collective-permute"] == 28
+    assert got["all-reduce"] == 0
+    assert got["total"] == got["all-gather"] + got["collective-permute"]
+
+
+def test_shift_vs_dense_compiled_collective_bytes(devices):
+    """The mix_shifts docstring claim, measured: a folded ring (n=32 on
+    8 devices) ships 2 single-lane shards per device per round through
+    ``collective-permute`` while the dense path all-gathers the full
+    fleet — counted from the compiled HLO of both programs."""
+    n, d = 32, 8
+    mesh = make_mesh(d)
+    lanes = n // d
+    mm = build_mixing_matrices("circle", "metropolis", n)
+    ids = (0, 1, n - 1)
+    coeffs = coeffs_for_matrix(mm.matrices[0], ids)
+    tree = shard_worker_tree(_tree(n, seed=3), mesh)
+    per_worker_bytes = sum(
+        int(np.prod(x.shape[1:])) * x.dtype.itemsize
+        for x in jax.tree.leaves(tree))
+
+    f_shift = jax.jit(lambda t, c: mix_shifts(t, ids, c, mesh))
+    b_shift = hlo_collective_bytes(
+        f_shift.lower(tree, coeffs).compile().as_text())
+    f_dense = jax.jit(lambda t, w: mix_dense(t, w, mesh))
+    b_dense = hlo_collective_bytes(
+        f_dense.lower(tree, mm.matrices[0]).compile().as_text())
+
+    # Shift path: ppermute only, carrying exactly the lane unions the
+    # consuming shifts need — shift_comm_lanes(...) worker-lane shards.
+    shipped = shift_comm_lanes(ids, lanes, d)
+    assert shipped == 2          # the folded-ring headline number
+    assert b_shift["all-gather"] == 0
+    assert b_shift["collective-permute"] == shipped * per_worker_bytes
+    # Dense path: all_gather materialises all n lanes on every device.
+    assert b_dense["collective-permute"] == 0
+    assert b_dense["all-gather"] == n * per_worker_bytes
+    # The byte-savings claim itself: n gathered lanes vs `shipped`.
+    assert b_dense["total"] == (n // shipped) * b_shift["total"]
+
+
+# ---------------------------------------------------------------------
+# Engine-level parity / determinism / resume
+# ---------------------------------------------------------------------
+
+def _gossip_sc(us="scatter", **kw):
+    base = _gossip_cfg(**kw)
+    return base.replace(gossip=dataclasses.replace(
+        base.gossip, update_sharding=us, update_bucket_mb=0.05))
+
+
+def test_gossip_scatter_parity_repro_blocked(devices):
+    from dopt.engine import GossipTrainer
+
+    t_off = GossipTrainer(_gossip_sc("off"))
+    h_off = t_off.run(rounds=3)
+    t_sc = GossipTrainer(_gossip_sc())
+    h_sc = t_sc.run(rounds=3)
+    # Dense-parity: f32 allclose (reduce-scatter reassociates the sum,
+    # so bit-equality vs dense is not required).
+    np.testing.assert_allclose(_flat(t_off.params), _flat(t_sc.params),
+                               rtol=2e-5, atol=1e-6)
+    for ra, rb in zip(h_off.rows, h_sc.rows):
+        for k in ra:
+            if isinstance(ra[k], float):
+                assert abs(ra[k] - rb[k]) < 5e-4, (k, ra[k], rb[k])
+    # Run-to-run bit-reproducibility of the scatter path.
+    t_sc2 = GossipTrainer(_gossip_sc())
+    t_sc2.run(rounds=3)
+    assert np.array_equal(_flat(t_sc.params), _flat(t_sc2.params))
+    # Blocked execution composes: same bits as per-round.
+    t_blk = GossipTrainer(_gossip_sc())
+    t_blk.run(rounds=3, block=3)
+    assert np.array_equal(_flat(t_sc.params), _flat(t_blk.params))
+
+
+@pytest.mark.slow
+def test_gossip_scatter_resume_exact(devices, tmp_path):
+    from dopt.engine import GossipTrainer
+
+    cont = GossipTrainer(_gossip_sc())
+    cont.run(rounds=4)
+    killed = GossipTrainer(_gossip_sc())
+    killed.run(rounds=2)
+    killed.save(tmp_path / "ck")
+    resumed = GossipTrainer(_gossip_sc())
+    resumed.restore(tmp_path / "ck")
+    resumed.run(rounds=2)
+    assert np.array_equal(_flat(cont.params), _flat(resumed.params))
+    assert cont.history.rows == resumed.history.rows
+
+
+@pytest.mark.slow
+def test_gossip_scatter_composes_with_faults_blocked(devices):
+    """Crash/straggler faults stay data under scatter (repaired
+    matrices feed the same reduce-scatter), so faulted scatter runs
+    keep the fused blocked scan bit-exact."""
+    from dopt.config import FaultConfig
+    from dopt.engine import GossipTrainer
+
+    cfg = _gossip_sc().replace(
+        faults=FaultConfig(crash=0.3, straggle=0.3, straggle_frac=0.5))
+    a = GossipTrainer(cfg)
+    a.run(rounds=4)
+    b = GossipTrainer(cfg)
+    b.run(rounds=4, block=4)
+    assert np.array_equal(_flat(a.params), _flat(b.params))
+    assert a.history.faults == b.history.faults
+
+
+def _fed_sc(us="scatter", **kw):
+    base = _fed_cfg(**kw)
+    return base.replace(federated=dataclasses.replace(
+        base.federated, update_sharding=us, update_bucket_mb=0.05))
+
+
+@pytest.mark.slow
+def test_federated_scatter_parity_and_repro(devices):
+    from dopt.engine import FederatedTrainer
+
+    t_off = FederatedTrainer(_fed_sc("off"))
+    t_off.run(rounds=3)
+    t_sc = FederatedTrainer(_fed_sc())
+    t_sc.run(rounds=3)
+    np.testing.assert_allclose(_flat(t_off.theta), _flat(t_sc.theta),
+                               rtol=2e-5, atol=1e-6)
+    t_sc2 = FederatedTrainer(_fed_sc())
+    t_sc2.run(rounds=3, block=3)   # blocked scatter, same bits
+    assert np.array_equal(_flat(t_sc.theta), _flat(t_sc2.theta))
+
+
+# ---------------------------------------------------------------------
+# Eligibility: ineligible compositions are rejected loudly
+# ---------------------------------------------------------------------
+
+def test_scatter_rejections(devices):
+    from dopt.config import FaultConfig, RobustConfig
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    with pytest.raises(ValueError, match="unknown update_sharding"):
+        GossipTrainer(_gossip_sc("sliced"))
+    with pytest.raises(ValueError, match="robust layer"):
+        GossipTrainer(_gossip_sc().replace(
+            robust=RobustConfig(clip_radius=1.0)))
+    with pytest.raises(ValueError, match="link faults"):
+        GossipTrainer(_gossip_sc().replace(
+            faults=FaultConfig(msg_drop=0.2)))
+    with pytest.raises(ValueError, match="comm_dtype"):
+        GossipTrainer(_gossip_sc(
+            gossip={"comm_dtype": "bfloat16", "update_sharding": "scatter"}))
+    with pytest.raises(ValueError, match="no dense mixing"):
+        GossipTrainer(_gossip_sc(
+            gossip={"algorithm": "nocons", "update_sharding": "scatter"}))
+    fed = _fed_sc()
+    with pytest.raises(ValueError, match="masked-MEAN"):
+        FederatedTrainer(fed.replace(
+            robust=RobustConfig(aggregator="median")))
+    with pytest.raises(ValueError, match="staleness"):
+        FederatedTrainer(fed.replace(
+            federated=dataclasses.replace(fed.federated, staleness_max=2),
+            faults=FaultConfig(msg_delay=0.2)))
+    with pytest.raises(ValueError, match="compact"):
+        FederatedTrainer(fed.replace(
+            federated=dataclasses.replace(fed.federated, compact=True)))
+
+
+# ---------------------------------------------------------------------
+# Phase attribution + bench hardening helpers (pure units)
+# ---------------------------------------------------------------------
+
+def test_phase_classification():
+    from dopt.utils.profiling import classify_phase, phase_totals
+
+    assert classify_phase("convolution", "jit(f)/conv_general") == "conv"
+    # dtype casts must NOT count as conv — the bf16 leg is full of
+    # convert ops and conv_fraction is the acceptance metric.
+    assert classify_phase("convert", "jit(f)/convert.5") == "other"
+    assert classify_phase("all-gather", None) == "comm"
+    assert classify_phase("fusion", "jit(f)/dopt_mix/dot_general") == "comm"
+    assert classify_phase("fusion",
+                          "jit(f)/dopt_update/sub") == "update"
+    # update tag wins over the enclosing mix scope (the sharded update
+    # nests inside the scatter collective's scope).
+    assert classify_phase(
+        "fusion", "jit(f)/dopt_mix/dopt_update/div") == "update"
+    assert classify_phase("fusion", "jit(f)/add") == "other"
+    got = phase_totals([("convolution", "conv", 60.0),
+                        ("all-gather", "ag", 20.0),
+                        ("fusion", "x/dopt_update/sub", 20.0)])
+    assert got["conv_fraction"] == pytest.approx(0.6)
+    assert got["comm_fraction"] == pytest.approx(0.2)
+    assert got["update_fraction"] == pytest.approx(0.2)
+    assert got["other_us"] == 0.0
+
+
+def test_bench_trimmed_stats():
+    import bench
+
+    # >= 4 samples: min and max are discarded before median/spread.
+    med, spread, kept = bench._trimmed_stats([10.0, 9.9, 10.1, 0.1, 50.0])
+    assert kept == [9.9, 10.0, 10.1]
+    assert med == 10.0
+    assert spread == pytest.approx(100.0 * 0.2 / 10.0)
+    # < 4 samples: plain median/spread.
+    med2, spread2, kept2 = bench._trimmed_stats([2.0, 4.0])
+    assert med2 == 3.0 and kept2 == [2.0, 4.0]
+    assert spread2 == pytest.approx(100.0 * 2.0 / 3.0)
