@@ -56,9 +56,19 @@ update fractions of device time (named-scope attribution,
 ``dopt.utils.profiling.classify_phase``) so the "conv fraction" claim
 is measured, not guessed.
 
-Prints ONE JSON line:
+Round 7: the client-scale legs (dopt.population) — baseline3 with a
+1k- and a 10k-client population registry, cohort-sampled onto the 16
+lanes in waves with hierarchical (bucketed reduce-scatter)
+aggregation.  Each leg prints its own JSON line with the
+``clients_per_sec`` headline (cohort · rounds/sec — client visits
+served per second) plus ``population``/``cohort_size``/``waves``
+fields; ``--quick`` emits the 1k line as a CI artifact.
+
+Prints the main JSON line:
   {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N,
-   "conv_fraction": f, "comm_fraction": f, "update_fraction": f, ...}
+   "conv_fraction": f, "comm_fraction": f, "update_fraction": f,
+   "clients_per_sec_1k": N, "clients_per_sec_10k": N, ...}
+plus one JSON line per client-scale leg.
 """
 
 from __future__ import annotations
@@ -179,6 +189,89 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
         "chaos_speedup_vs_per_round": round(
             blocked["rounds_per_sec"] / per_round["rounds_per_sec"], 2),
         "chaos_samples_per_sec": round(blocked["samples_per_sec"], 1),
+    }
+
+
+def _population_config(*, clients: int, cohort: int, train_size: int,
+                       test_size: int, local_ep: int | None = None,
+                       model: str | None = None):
+    """The client-scale leg: baseline3 (FedAvg, 16 non-IID MNIST
+    shards, model1) with the worker==lane equation broken — a
+    ``clients``-record registry sampling a ``cohort`` each round onto
+    the 16 lanes in ceil(cohort/16) waves with hierarchical (bucketed
+    reduce-scatter) aggregation (dopt.population).  ``model`` swaps the
+    headline model1 CNN for a lighter one (the --quick CI mode runs the
+    mlp — same registry/wave/reduce machinery end to end, CPU-viable
+    FLOPs; the chaos quick leg set the precedent)."""
+    import dataclasses
+
+    from dopt.config import PopulationConfig
+    from dopt.presets import baseline_3_fedavg_noniid
+
+    cfg = baseline_3_fedavg_noniid()
+    data = dataclasses.replace(cfg.data, synthetic_train_size=train_size,
+                               synthetic_test_size=test_size,
+                               plan_impl="native")
+    fed = cfg.federated
+    if local_ep is not None:
+        fed = dataclasses.replace(fed, local_ep=local_ep)
+    mdl = cfg.model
+    if model is not None:
+        mdl = dataclasses.replace(mdl, model=model, faithful=False)
+    return dataclasses.replace(
+        cfg, name=f"bench-baseline3-xclients-{clients}", data=data,
+        federated=fed, model=mdl,
+        population=PopulationConfig(clients=clients, cohort=cohort))
+
+
+def _measure_population(*, clients: int, cohort: int, train_size: int,
+                        test_size: int, rounds: int, repeats: int,
+                        local_ep: int | None = None,
+                        model: str | None = None) -> dict:
+    """Client-scale throughput: rounds/sec of the population wave loop
+    and the headline ``clients_per_sec`` = cohort · rounds/sec (how many
+    client visits the trainer serves per second).  The federated engine
+    evaluates the global model every round (the reference's cadence),
+    so — unlike the gossip legs — eval is part of the measured round;
+    the JSON notes it.  The wall reduction mirrors ``_measure``
+    (min/max-trimmed median over independent blocks)."""
+    import jax
+
+    from dopt.engine.federated import FederatedTrainer
+
+    cfg = _population_config(clients=clients, cohort=cohort,
+                             train_size=train_size, test_size=test_size,
+                             local_ep=local_ep, model=model)
+    trainer = FederatedTrainer(cfg, eval_train=False)
+    trainer.run(rounds=1)   # warmup: compiles the wave-scan round
+    rps = []
+    total = 0.0
+    for _ in range(repeats):
+        t0 = time.time()
+        trainer.run(rounds=rounds)
+        jax.block_until_ready(trainer.theta)
+        elapsed = time.time() - t0
+        total += elapsed
+        rps.append(rounds / elapsed)
+    med, spread, _ = _trimmed_stats(rps)
+    reg = trainer._registry
+    last = trainer.history.rows[-1]
+    return {
+        "metric": "clients_per_sec_baseline3_xclients",
+        "value": round(med * reg.cohort_size, 2),
+        "unit": "clients/sec",
+        "clients_per_sec": round(med * reg.cohort_size, 2),
+        "model": cfg.model.model,
+        "population": reg.clients,
+        "cohort_size": reg.cohort_size,
+        "waves": reg.waves,
+        "lanes": reg.lanes,
+        "rounds_per_sec": round(med, 4),
+        "spread_pct": round(spread, 2),
+        "measured_seconds": round(total, 2),
+        "eval_fused": True,
+        "final_test_acc": round(float(last["test_acc"]), 4),
+        "total_trained_rounds": trainer.round,
     }
 
 
@@ -334,6 +427,8 @@ def main() -> None:
                          "JSON line and exits — the CI artifact mode")
     ap.add_argument("--skip-chaos", action="store_true",
                     help="skip the chaos-cocktail (degraded-network) leg")
+    ap.add_argument("--skip-clients", action="store_true",
+                    help="skip the client-scale (population registry) legs")
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--block", type=int, default=None,
                     help="rounds fused per jit dispatch (default: all "
@@ -387,6 +482,16 @@ def main() -> None:
         print(json.dumps({"metric": "gossip_rounds_per_sec_chaos",
                           "value": chaos["gossip_rounds_per_sec_chaos"],
                           "unit": "rounds/sec", "quick": True, **chaos}))
+        if not args.skip_clients:
+            # Client-scale quick line: the 1k-client baseline3 cohort
+            # loop end to end (sampling → 4-wave scan → hierarchical
+            # reduce → registry feedback) on tiny data, one local
+            # epoch — the CI artifact the full bench measures properly.
+            popm = _measure_population(clients=1_000, cohort=64,
+                                       train_size=1_536, test_size=512,
+                                       rounds=args.rounds or 2,
+                                       repeats=2, local_ep=1, model="mlp")
+            print(json.dumps({**popm, "quick": True}))
         return
 
     train_size = 6_000 if args.smoke else 60_000
@@ -461,6 +566,24 @@ def main() -> None:
               f"per-round {chaos['chaos_per_round_rounds_per_sec']:.4f} "
               f"r/s ({chaos['chaos_speedup_vs_per_round']:.2f}x; "
               f"acc={chaos['chaos_avg_test_acc']:.4f})", file=sys.stderr)
+    if not args.skip_clients:
+        # Client-scale headlines (dopt.population): clients/sec served
+        # at population 1k (cohort 64 → 4 waves) and 10k (cohort 256 →
+        # 16 waves) on baseline3 — each its own JSON line, with the
+        # summary numbers folded into the main line.
+        for n_clients, cohort in ((1_000, 64), (10_000, 256)):
+            popm = _measure_population(
+                clients=n_clients, cohort=cohort, train_size=train_size,
+                test_size=test_size,
+                rounds=max(rounds // 4, 2) if not args.smoke else 2,
+                repeats=repeats)
+            result[f"clients_per_sec_{n_clients // 1000}k"] = popm["value"]
+            print(f"# clients/sec @ population={n_clients} "
+                  f"(cohort {cohort}, {popm['waves']} waves): "
+                  f"{popm['value']:.1f} "
+                  f"({popm['rounds_per_sec']:.3f} rounds/s, "
+                  f"acc={popm['final_test_acc']:.4f})", file=sys.stderr)
+            print(json.dumps(popm))
     if not args.skip_faithful:
         faith = _measure(
             _config(fast=False, train_size=train_size, test_size=test_size,

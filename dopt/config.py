@@ -456,6 +456,44 @@ class RobustConfig:
 
 
 @dataclass(frozen=True)
+class PopulationConfig:
+    """Client population registry (``dopt.population``).
+
+    Decouples the client POPULATION (1k–10k host-side client records)
+    from the fixed-width device LANES: each round a seeded, stateless
+    cohort sampler draws ``cohort`` clients from the eligible
+    population, the cohort is bound onto the existing validity-masked
+    lanes in ``ceil(cohort / lanes)`` waves, per-device partial
+    weighted sums accumulate across the waves, and ONE cross-device
+    bucketed reduce (the ``masked_average_scatter`` flat-tree path)
+    forms the round's aggregate — so cohort size scales past what the
+    lane width (or device memory) can hold in one pass.  Per-client
+    state (shard assignment, participation counts, staleness,
+    quarantine streaks) lives in host-side arrays keyed by CLIENT id,
+    so adversaries and quarantine sentences persist across cohorts.
+    ``None`` on ExperimentConfig keeps the exact pre-population
+    programs (python-level gating)."""
+
+    clients: int = 1000
+    # Population size P: how many client records the registry holds.
+    # Clients are stateless FedAvg/FedProx participants (they load
+    # theta, train their assigned shard, return an update) — only their
+    # registry row persists between the rounds they are sampled in.
+    cohort: int = 64
+    # Clients sampled per round (M).  When fewer than M clients are
+    # eligible (quarantine/churn), the round runs the smaller cohort —
+    # cohort size is DATA (lane validity masks), never a shape.
+    seed: int | None = None
+    # Cohort-sampler seed; None = the experiment seed.  Draws are keyed
+    # statelessly by (seed, round), so sampling is bit-reproducible and
+    # resume-exact without any persisted RNG state.
+    lanes: int | None = None
+    # Device lane width per wave (the fixed execution width the cohort
+    # is folded onto).  None = ``data.num_users`` (one lane per data
+    # shard).  Must divide the device count evenly, like num_users.
+
+
+@dataclass(frozen=True)
 class SeqLMConfig:
     """Sequence-parallel language-model training (``dopt.engine.seqlm``).
 
@@ -503,6 +541,11 @@ class ExperimentConfig:
     # Byzantine-robust aggregation & quarantine (dopt.robust).  None =
     # the plain masked-mean programs (bit-identical to pre-robust runs;
     # non-finite updates are still screened from the federated mean).
+    population: PopulationConfig | None = None
+    # Client population registry (dopt.population): per-round cohort
+    # sampling from a 1k–10k client population with hierarchical
+    # (multi-wave) aggregation.  None = the classic worker==lane
+    # engines, bit-identical to pre-population programs.
     # Execution backend — the pluggable Worker(backend=...) boundary:
     # "jax" runs the TPU/mesh engines; "torch" runs the SAME experiment
     # on the faithful sequential CPU oracle (dopt.engine.torch_backend)
@@ -627,7 +670,7 @@ def exp_details(cfg: ExperimentConfig) -> str:
     """Human-readable config dump (reference ``exp_details``, utils.py:147-165)."""
     lines = [f"Experiment: {cfg.name}", f"  seed      : {cfg.seed}", f"  backend   : {cfg.backend}"]
     for section in ("data", "model", "optim", "federated", "gossip", "faults",
-                    "robust"):
+                    "robust", "population"):
         sub = getattr(cfg, section)
         if sub is None:
             continue

@@ -1,6 +1,8 @@
 from dopt.data.datasets import Dataset, load_dataset
-from dopt.data.partition import (holdout_split, iid_split, noniid_split,
-                                 partition, reassign_shards)
+from dopt.data.partition import (assign_client_shards, holdout_split,
+                                 iid_split, noniid_split,
+                                 orphan_shard_adopters, partition,
+                                 reassign_shards)
 from dopt.data.pipeline import (BatchPlan, eval_batches, make_batch_plan,
                                 gather_batches, sharded_eval_batches,
                                 stacked_eval_batches)
@@ -13,6 +15,8 @@ __all__ = [
     "noniid_split",
     "partition",
     "reassign_shards",
+    "assign_client_shards",
+    "orphan_shard_adopters",
     "BatchPlan",
     "eval_batches",
     "make_batch_plan",

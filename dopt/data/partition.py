@@ -141,6 +141,61 @@ def partition(
     return groups, matrix
 
 
+def assign_client_shards(population: int, num_shards: int, *,
+                         seed: int = 0,
+                         mode: str = "round_robin") -> np.ndarray:
+    """Population-sized shard assignment (``dopt.population``): map each
+    of ``population`` client ids onto one of the ``num_shards`` data
+    shards the partitioners produced.
+
+    mode='round_robin' — client c trains shard c % num_shards: exactly
+    balanced, and when ``population == num_shards`` it is the identity
+    map (client c IS shard c), which is what makes the cohort-vs-flat
+    parity contract statable at all.  mode='random' — a seeded
+    permutation of the round-robin assignment: still balanced to within
+    one client per shard, but which clients share a shard is
+    randomised (the realistic regime where clients arrive in no
+    particular order).  Returns an int32 [population] vector."""
+    if population < 1:
+        raise ValueError(f"population must be >= 1, got {population}")
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    base = (np.arange(population) % num_shards).astype(np.int32)
+    if mode == "round_robin":
+        return base
+    if mode == "random":
+        rng = np.random.default_rng(np.random.SeedSequence([seed, 0x5A4D]))
+        return base[rng.permutation(population)].astype(np.int32)
+    raise ValueError(
+        f"unknown client-shard assignment mode {mode!r}; "
+        "one of round_robin|random")
+
+
+def orphan_shard_adopters(assignment: np.ndarray, alive: np.ndarray,
+                          num_shards: int) -> dict[int, int]:
+    """Shard-reassignment map for population churn: a shard whose
+    ASSIGNED clients are all away this round is orphaned — no sampled
+    cohort could ever train it — so it is adopted by the next shard id
+    (mod S) that still has an alive client, and ``reassign_shards``
+    interleaves the orphan's rows into the adopter's for the round.
+    The mirror of ``FaultPlan.adopters_for`` one level up: workers
+    adopt workers' shards, shards adopt shards' clients.  Empty when
+    every shard (or none) has an alive client."""
+    assignment = np.asarray(assignment)
+    alive = np.asarray(alive, bool)
+    covered = np.zeros(num_shards, bool)
+    np.logical_or.at(covered, assignment[alive], True)
+    if covered.all() or not covered.any():
+        return {}
+    out: dict[int, int] = {}
+    for s in np.nonzero(~covered)[0]:
+        a = (int(s) + 1) % num_shards
+        while not covered[a]:
+            a = (a + 1) % num_shards
+        out[int(s)] = a
+    return out
+
+
 def reassign_shards(index_matrix: np.ndarray,
                     adopters: dict[int, int]) -> np.ndarray:
     """Deterministic shard reassignment for elastic membership

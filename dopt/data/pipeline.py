@@ -54,6 +54,7 @@ def make_batch_plan(
     drop_last: bool = False,
     impl: str = "numpy",
     workers: np.ndarray | None = None,
+    rows: np.ndarray | None = None,
 ) -> BatchPlan:
     """Build the shuffled batch plan for one round.
 
@@ -69,6 +70,15 @@ def make_batch_plan(
     id, not the row position.  This keeps the compact-sampling fast path
     O(m) on the host instead of O(W).
 
+    ``rows`` (optional [m] int array, requires ``workers``) decouples
+    the DATA rows gathered from the RNG identities: row ``rows[i]`` of
+    ``index_matrix`` is shuffled under worker key ``workers[i]``.  The
+    client-population path (``dopt.population``) uses this to bind a
+    cohort of clients onto their assigned data shards — two clients
+    sharing a shard still draw DISTINCT client-keyed batch streams,
+    and when client ids equal shard ids the plan is bit-identical to
+    the classic per-worker plan (the cohort-vs-flat parity contract).
+
     ``impl='native'`` fills the plan with the C++ host runtime
     (``dopt.native``) — same contract and determinism key, different
     (xoshiro) RNG stream, so it is the throughput mode, not the
@@ -76,9 +86,14 @@ def make_batch_plan(
     library is unavailable.
     """
     worker_ids = None
+    if rows is not None and workers is None:
+        raise ValueError("make_batch_plan: rows= requires workers= "
+                         "(the RNG identity keys)")
     if workers is not None:
         worker_ids = np.asarray(workers, dtype=np.int64)
-        index_matrix = index_matrix[worker_ids]
+        sel = (np.asarray(rows, dtype=np.int64) if rows is not None
+               else worker_ids)
+        index_matrix = index_matrix[sel]
     if impl == "native":
         from dopt.native import fill_batch_plan_native
 

@@ -244,6 +244,96 @@ class FederatedTrainer:
         self._stale_weight = np.zeros(w, np.float64)
         self._stale_origin = np.zeros(w, np.int64)
 
+        # Client population registry (ISSUE 6 tentpole, dopt.population):
+        # decouple the client POPULATION (1k–10k host-side records) from
+        # the device lanes.  Each round a stateless seeded sampler draws
+        # a cohort, the cohort binds onto ceil(cohort/lanes) fixed-width
+        # validity-masked lane WAVES, per-device partial weighted sums
+        # accumulate across the waves inside one jitted scan, and ONE
+        # cross-device bucketed reduce (masked_average_scatter with the
+        # cohort-weight denominator) forms the aggregate.  Clients are
+        # STATELESS FedAvg/FedProx participants — only their registry
+        # row (shard assignment, participation, streaks, quarantine)
+        # persists, keyed by CLIENT id so adversaries and sentences
+        # survive re-sampling.  population=None keeps the exact
+        # pre-population programs (python gating).
+        self._registry = None
+        pop = cfg.population
+        if pop is not None:
+            from dopt.population import (ClientRegistry,
+                                         validate_population_config)
+
+            validate_population_config(pop)
+            if f.algorithm not in ("fedavg", "fedprox"):
+                raise ValueError(
+                    "population mode needs a stateless-client algorithm "
+                    f"(fedavg|fedprox): {f.algorithm!r} carries "
+                    "per-client companion state no registry row can hold")
+            if cfg.data.local_holdout > 0:
+                raise ValueError(
+                    "population mode is incompatible with the local "
+                    "train/val holdout (per-epoch client history needs "
+                    "persistent per-client state) — drop one of the two")
+            if f.compact:
+                raise ValueError(
+                    "FederatedConfig.compact=True is incompatible with "
+                    "population mode (the wave loop IS the compact "
+                    "execution: fixed-width lanes, validity as data)")
+            if f.staleness_max > 0:
+                raise ValueError(
+                    "population mode does not compose with staleness-"
+                    "aware aggregation (the one-slot-per-WORKER buffer "
+                    "has no per-client form) — drop one of the two")
+            if f.comm_dtype:
+                raise ValueError(
+                    "population mode's hierarchical reduce is its own "
+                    "wire path; comm_dtype applies to the plain masked-"
+                    "mean reduce only — drop one of the two")
+            if self._scatter:
+                raise ValueError(
+                    "population mode always aggregates via the bucketed "
+                    "scatter flat-tree path; keep update_sharding='off' "
+                    "(the knob only retargets the lane engines)")
+            if aggregator != "mean":
+                raise ValueError(
+                    "population mode streams per-wave partial SUMS; "
+                    f"aggregator={aggregator!r} needs every update "
+                    "materialised at once — drop one of the two")
+            if cfg.mesh_hosts:
+                raise ValueError(
+                    "population mode runs its reduce over a flat 1-D "
+                    "worker mesh; hybrid (hosts × ici) meshes are not "
+                    "supported")
+            if has_corrupt and cfg.faults.corrupt_mode == "stale":
+                raise ValueError(
+                    "corrupt_mode='stale' replays the worker's previous "
+                    "update; population clients are stateless (no "
+                    "previous update exists) — use nan|inf|scale|"
+                    "signflip")
+            lanes = int(pop.lanes or w)
+            if lanes != w:
+                # The wave width is an execution choice independent of
+                # the shard count: rebuild the mesh around it (the
+                # [W, ...] data-shard stacks still ride this mesh, so
+                # the shard count must stay divisible).
+                self.mesh = make_worker_mesh(lanes, cfg.mesh_devices,
+                                             cfg.mesh_hosts)
+                self._sharding = worker_sharding(self.mesh)
+            if len(self.mesh.axis_names) != 1:
+                raise ValueError(
+                    "population mode needs a flat 1-D worker mesh "
+                    f"(got {self.mesh.shape})")
+            if lanes % self.mesh.size or w % self.mesh.size:
+                raise ValueError(
+                    f"population lanes={lanes} and data.num_users={w} "
+                    f"must both divide the {self.mesh.size}-device mesh")
+            self._registry = ClientRegistry(
+                pop, num_shards=w, seed=cfg.seed, faults=cfg.faults,
+                robust=rcfg, lanes=lanes)
+            # Quarantine is CLIENT-keyed in population mode (the
+            # registry's streaks); the lane-keyed machinery stays dark.
+            self._quarantine_on = False
+
         self.dataset = load_dataset(
             cfg.data.dataset, data_dir=cfg.data.data_dir,
             train_size=cfg.data.synthetic_train_size,
@@ -316,6 +406,20 @@ class FederatedTrainer:
                 stacked, fold=self.mesh.size,
                 bucket_bytes=int(f.update_bucket_mb * (1 << 20)))
             if self._scatter else None)
+        # Population mode's bucketing plan: the cross-wave accumulator
+        # is an f32 [lanes, ...] stacked tree (weighted sums accumulate
+        # at full precision whatever param_dtype is), reduced once per
+        # round through the same bucketed flat-tree path as
+        # update_sharding='scatter'.
+        self._pop_spec = (
+            make_update_shard_spec(
+                jax.tree.map(
+                    lambda x: np.zeros(
+                        (self._registry.lanes,) + x.shape, np.float32),
+                    t_host),
+                fold=self.mesh.size,
+                bucket_bytes=int(f.update_bucket_mb * (1 << 20)))
+            if self._registry is not None else None)
         # Staleness buffer: one pending (late) update slot per worker.
         self._stale_p = (
             shard_worker_tree(jax.tree.map(np.zeros_like, stacked),
@@ -992,6 +1096,118 @@ class FederatedTrainer:
 
         self._chaos_block_fn = jax.jit(chaos_block_fn,
                                        donate_argnums=(1, 2, 3))
+
+        # ---- population wave loop (hierarchical aggregation) ----------
+        # One jitted dispatch per round: lax.scan over the cohort's
+        # waves.  Each wave loads theta into all lanes (stateless
+        # clients: fresh zero momentum), trains, injects the round's
+        # client-keyed corruption, screens non-finite lanes, and folds
+        # the valid lanes' updates into an f32 per-lane accumulator —
+        # per-DEVICE partial sums, no cross-device traffic per wave.
+        # After the scan, ONE bucketed reduce (masked_average_scatter
+        # over the flat-tree spec, denom = total cohort weight) forms
+        # theta.  Cohort size, survivor count and corruption are all
+        # DATA ([K, lanes] masks), so every round of a population run
+        # shares this single compiled program.
+        if self._registry is not None:
+            pop_lanes = self._registry.lanes
+            pop_spec = self._pop_spec
+            pop_clip = clip_radius
+
+            def pop_round_fn(theta, idxs, bws, valids, limits, train_x,
+                             train_y, ex, ey, ew, cmasks=None):
+                acc0 = jax.tree.map(
+                    lambda x: jnp.zeros((pop_lanes,) + x.shape,
+                                        jnp.float32), theta)
+
+                def wave(carry, xs):
+                    acc, acc_w, lsum, asum = carry
+                    if has_corrupt:
+                        valid, cmask, lim, idx, bw = xs
+                    else:
+                        valid, lim, idx, bw = xs
+                    start = broadcast_to_workers(theta, pop_lanes)
+                    mom0 = jax.tree.map(jnp.zeros_like, start)
+                    bx = train_x[idx]
+                    by = train_y[idx]
+                    args = ((start, mom0, bx, by, bw, lim) if may_straggle
+                            else (start, mom0, bx, by, bw))
+                    if algorithm == "fedprox":
+                        p_t, _m_t, losses, accs = local(*args, theta)
+                    else:
+                        p_t, _m_t, losses, accs = local(*args)
+                    if has_corrupt:
+                        # Client-keyed lies: the [lanes] mask is the
+                        # population fault stream gathered at this
+                        # wave's client ids, so a pinned adversary lies
+                        # in every cohort that samples it.
+                        p_t = corrupt_update(p_t, cmask, corrupt_mode,
+                                             corrupt_scale, ref=theta,
+                                             prev=start)
+                    fin = finite_lane_mask(p_t) * valid
+                    agg_in = (clip_to_ball(p_t, theta, pop_clip)
+                              if pop_clip > 0 else p_t)
+                    # Zero screened/padding lanes BEFORE accumulating:
+                    # a 0-weighted NaN still poisons the sum.
+                    zed = _where_mask(
+                        fin, agg_in,
+                        jax.tree.map(jnp.zeros_like, agg_in))
+                    acc = jax.tree.map(
+                        lambda a, x: a + x.astype(jnp.float32), acc, zed)
+                    acc_w = acc_w + fin
+                    lane_loss = losses.mean(axis=1)
+                    lane_loss = jnp.where(jnp.isfinite(lane_loss),
+                                          lane_loss, 0.0)
+                    lane_acc = accs.mean(axis=1)
+                    lane_acc = jnp.where(jnp.isfinite(lane_acc),
+                                         lane_acc, 0.0)
+                    lsum = lsum + (lane_loss * fin).sum()
+                    asum = asum + (lane_acc * fin).sum()
+                    screened = valid * (1.0 - finite_lane_mask(p_t))
+                    return (acc, acc_w, lsum, asum), screened
+
+                xs = [valids]
+                if has_corrupt:
+                    xs.append(cmasks)
+                xs += [limits, idxs, bws]
+                (acc, acc_w, lsum, asum), scr = jax.lax.scan(
+                    wave,
+                    (acc0, jnp.zeros(pop_lanes, jnp.float32),
+                     jnp.float32(0.0), jnp.float32(0.0)),
+                    tuple(xs))
+                tot = acc_w.sum()
+                denom = jnp.where(tot > 0, tot, 1.0)
+                avg = masked_average_scatter(
+                    acc, jnp.ones(pop_lanes, jnp.float32), agg_mesh,
+                    pop_spec, denom=denom)
+                # Empty round (everyone crashed/quarantined): theta
+                # passes through, like the lane engines' all-failed
+                # guard.
+                new_theta = jax.tree.map(
+                    lambda a, th: jnp.where(tot > 0, a.astype(th.dtype),
+                                            th),
+                    avg, theta)
+                cnt = jnp.maximum(tot, 1.0)
+                evalm = global_eval(new_theta, ex, ey, ew)
+                # Packed host metrics (one fetch): [local_loss,
+                # test_acc, test_loss_sum, train_loss, train_acc] +
+                # [K·lanes] screened flags.  train_loss/train_acc are
+                # the COHORT's local-training means — the all-client
+                # train eval has no population-scale analog.
+                parts = [(lsum / cnt)[None], evalm["acc"][None],
+                         evalm["loss_sum"][None], (lsum / cnt)[None],
+                         (asum / cnt)[None], scr.ravel()]
+                packed = jnp.concatenate(
+                    [p.astype(jnp.float32) for p in parts])
+                return new_theta, packed
+
+            self._pop_round_fn = jax.jit(pop_round_fn)
+            from dopt.parallel.mesh import worker_axes as _wa
+
+            self._pop_sharding = jax.sharding.NamedSharding(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, _wa(self.mesh)))
+
         self._global_eval = jax.jit(global_eval)
         self._sample_rng = host_rng(cfg.seed, 314159)
 
@@ -1319,6 +1535,156 @@ class FederatedTrainer:
         valid[:len(sel)] = 1.0
         return sel_full, valid
 
+    # -- population mode (dopt.population) -----------------------------
+    def _cohort_participation(self, t: int):
+        """Sample round t's cohort from the population and apply its
+        CLIENT-keyed faults: returns (binding, [K, lanes] straggler
+        limits, [K, lanes] corrupt mask, ledger rows).  The priority
+        chain mirrors ``_round_participation`` (quarantine > churn >
+        crash > partition > deadline > uplink) except that quarantine
+        and churn exclude clients at SAMPLING time (the registry's
+        eligibility mask) and there is no staleness buffer — a delayed
+        uplink is dropped like the staleness_max=0 lane path.  Every
+        draw is stateless per (seed, round), so per-round execution and
+        killed-and-resumed runs log the identical trace.  NOTE: the
+        chain is a deliberate simplified twin of
+        ``_round_participation`` (whose staleness-capture branches and
+        exact ledger ordering are load-bearing there) — a change to
+        either chain's actions or priorities must be mirrored in the
+        other."""
+        reg = self._registry
+        rows = reg.begin_round(t)
+        away = reg.faults.away_for_round(t)
+        if reg.faults.has_churn:
+            # Population-keyed churn rows (client leave/rejoin + true
+            # orphan-SHARD adoptions) — the worker-level
+            # churn_ledger_rows assumes worker i owns shard i.
+            rows.extend(reg.churn_ledger_rows(t, away))
+        eligible = ~(reg.quarantine_until > t) & ~away
+        c = reg.faults.cfg
+        m = reg.cohort_size
+        n_draw = m
+        if reg.faults.active and c.over_select > 0.0:
+            n_draw = int(np.ceil(m * (1.0 + c.over_select)))
+        cohort = reg.sample_cohort(t, n_draw=n_draw, eligible=eligible)
+        binding_row_at = len(rows)
+        rf = reg.faults.for_round(t)
+        limits_p = FaultPlan.limits_for(rf, self._straggle_units)
+        up_drop, up_delay = reg.faults.uplink_for_round(t)
+        drop_policy = c is not None and c.straggler_policy == "drop"
+        survivors: list[int] = []
+        for i in cohort:
+            i = int(i)
+            if rf.crashed[i]:
+                rows.append({"round": int(t), "worker": i, "kind": "crash",
+                             "action": "dropped_from_round"})
+            elif rf.partition is not None and rf.partition[i] != 0:
+                rows.append({
+                    "round": int(t), "worker": i, "kind": "partition",
+                    "action": f"unreachable_in_group_{int(rf.partition[i])}"})
+            elif rf.straggler[i] and drop_policy:
+                rows.append({
+                    "round": int(t), "worker": i, "kind": "straggler",
+                    "action": (f"deadline_dropped_after_{int(limits_p[i])}"
+                               f"_of_{self._straggle_units}")})
+            elif up_drop[i]:
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "msg_drop", "action": "uplink_dropped"})
+            elif up_delay[i] > 0:
+                rows.append({"round": int(t), "worker": i,
+                             "kind": "msg_delay",
+                             "action": f"uplink_dropped_stale_"
+                                       f"{int(up_delay[i])}"})
+            else:
+                survivors.append(i)
+        for i in survivors[m:]:
+            rows.append({"round": int(t), "worker": i, "kind": "overselect",
+                         "action": "released_surplus"})
+        survivors_a = np.asarray(survivors[:m], np.int64)
+        binding = reg.bind(t, cohort, survivors_a)
+        rows.insert(binding_row_at, binding.ledger_row(reg.clients))
+        if self._may_straggle:
+            for i in np.sort(survivors_a):
+                if rf.straggler[i]:
+                    rows.append({
+                        "round": int(t), "worker": int(i),
+                        "kind": "straggler",
+                        "action": (f"truncated_to_{int(limits_p[i])}"
+                                   f"_of_{self._straggle_units}")})
+        limits = limits_p[binding.lane_ids]
+        cmask = np.zeros((binding.waves, binding.lanes), np.float32)
+        if self._has_corrupt and rf.corrupt is not None:
+            cmask = (rf.corrupt[binding.lane_ids].astype(np.float32)
+                     * binding.valid)
+            mode = self.cfg.faults.corrupt_mode
+            for i in np.sort(survivors_a):
+                if rf.corrupt[i]:
+                    rows.append({"round": int(t), "worker": int(i),
+                                 "kind": "corrupt",
+                                 "action": f"injected_{mode}"})
+        reg.record_participation(t, binding.survivors)
+        return binding, limits, cmask, rows
+
+    def _run_population(self, rounds: int, checkpoint_every: int = 0,
+                        checkpoint_path=None) -> History:
+        """Population-mode training loop: one jitted wave-scan dispatch
+        per round (the K-wave scan already amortises dispatch the way
+        blocked execution does for the lane engines; cohort size never
+        retraces)."""
+        cfg, f = self.cfg, self.cfg.federated
+        reg = self._registry
+        t0 = time.time()
+        for _ in range(rounds):
+            t = self.round
+            with self.timers.phase("host_batch_plan"):
+                binding, limits, cmask, rows = self._cohort_participation(t)
+                pm = reg.plan_matrix_for(t, self._train_matrix)
+                plans = [
+                    make_batch_plan(
+                        pm, batch_size=f.local_bs, local_ep=f.local_ep,
+                        seed=cfg.seed, round_idx=t,
+                        impl=cfg.data.plan_impl,
+                        workers=binding.lane_ids[k],
+                        rows=reg.shard_of[binding.lane_ids[k]])
+                    for k in range(binding.waves)
+                ]
+                idx = jax.device_put(np.stack([p.idx for p in plans]),
+                                     self._pop_sharding)
+                bw = jax.device_put(np.stack([p.weight for p in plans]),
+                                    self._pop_sharding)
+                valids = jnp.asarray(binding.valid)
+                lim = jnp.asarray(limits)
+            step_kw = ({"cmasks": jnp.asarray(cmask)}
+                       if self._has_corrupt else {})
+            self.theta, packed = self.timers.measure(
+                "round_step", self._pop_round_fn,
+                self.theta, idx, bw, valids, lim,
+                self._train_x, self._train_y, *self._eval, **step_kw)
+            packed = np.asarray(packed)   # ONE device→host fetch/round
+            ll, acc, loss_sum, t_loss, t_acc = (float(v)
+                                                for v in packed[:5])
+            n = len(binding.survivors)
+            # Survivors occupy the first n wave-major slots; padding
+            # lanes' flags are discarded like compact padding lanes'.
+            flags = packed[5:].reshape(-1)[:n]
+            reg.apply_screen_feedback(t, binding.survivors, flags, rows)
+            self.history.faults.extend(rows)
+            self.history.append(
+                round=t,
+                test_acc=acc,
+                test_loss=loss_sum,  # P1 summed-loss flavour
+                train_loss=t_loss,
+                train_acc=t_acc,
+                local_loss=ll,
+                cohort=n,
+                population=reg.clients,
+            )
+            self.round += 1
+            if checkpoint_every and self.round % checkpoint_every == 0:
+                self.save(checkpoint_path)
+        self.total_time = time.time() - t0
+        return self.history
+
     def _run_blocked(self, frac: float, rounds: int, block: int,
                      checkpoint_every: int = 0,
                      checkpoint_path=None) -> History:
@@ -1594,6 +1960,13 @@ class FederatedTrainer:
         block = f.block_rounds if block is None else block
         if checkpoint_every and checkpoint_path is None:
             raise ValueError("checkpoint_every requires checkpoint_path")
+        if self._registry is not None:
+            # Population mode: frac/block are lane-engine knobs — the
+            # cohort size comes from the registry, and each round is
+            # already one fused wave-scan dispatch.
+            return self._run_population(
+                rounds, checkpoint_every=checkpoint_every,
+                checkpoint_path=checkpoint_path)
         if block > 1 and not (self._quarantine_on
                               and self._use_compact(frac)):
             # Every mode but compact+quarantine is blocked-eligible:
@@ -1771,20 +2144,24 @@ class FederatedTrainer:
             # state: without them a resumed run would mis-admit (or
             # lose) the in-flight late updates.
             arrays["stale_p"] = self._stale_p
-        save_checkpoint(
-            path, arrays=arrays,
-            meta={"round": self.round, "name": self.cfg.name,
-                  "algorithm": self.cfg.federated.algorithm,
-                  "history": self.history.rows,
-                  "client_history": self.client_history.rows,
-                  "fault_ledger": self.history.faults,
-                  "screen_streak": self._screen_streak.tolist(),
-                  "quarantine_until": self._quarantine_until.tolist(),
-                  "stale_admit_round": self._stale_admit_round.tolist(),
-                  "stale_weight": self._stale_weight.tolist(),
-                  "stale_origin": self._stale_origin.tolist(),
-                  "sample_rng_state": self._sample_rng.bit_generator.state},
-        )
+        meta = {"round": self.round, "name": self.cfg.name,
+                "algorithm": self.cfg.federated.algorithm,
+                "history": self.history.rows,
+                "client_history": self.client_history.rows,
+                "fault_ledger": self.history.faults,
+                "screen_streak": self._screen_streak.tolist(),
+                "quarantine_until": self._quarantine_until.tolist(),
+                "stale_admit_round": self._stale_admit_round.tolist(),
+                "stale_weight": self._stale_weight.tolist(),
+                "stale_origin": self._stale_origin.tolist(),
+                "sample_rng_state": self._sample_rng.bit_generator.state}
+        if self._registry is not None:
+            # Registry state (participation counts, client-keyed streaks
+            # and sentences, shard-assignment integrity check) — the
+            # sampler itself is stateless, so this plus the round index
+            # is everything a bit-exact mid-population resume needs.
+            meta["population_registry"] = self._registry.state_dict()
+        save_checkpoint(path, arrays=arrays, meta=meta)
 
     def restore(self, path) -> None:
         from dopt.utils.checkpoint import load_checkpoint
@@ -1836,6 +2213,18 @@ class FederatedTrainer:
                 meta.get("stale_origin", [0] * w), np.int64)
         if meta.get("sample_rng_state"):
             self._sample_rng.bit_generator.state = meta["sample_rng_state"]
+        if self._registry is not None:
+            from dopt.utils.checkpoint import meta_expect
+
+            meta_expect(meta, what="population checkpoint",
+                        algorithm=self.cfg.federated.algorithm)
+            state = meta.get("population_registry")
+            if state is None:
+                raise ValueError(
+                    "population-mode trainer requires its registry state "
+                    "('population_registry') in the checkpoint — this "
+                    "checkpoint is from a lane-engine run")
+            self._registry.load_state(state)
 
     def evaluate_global(self) -> dict[str, float]:
         out = self._global_eval(self.theta, *self._eval)

@@ -249,6 +249,61 @@ class GossipTrainer:
         has_faults = self.faults.active
         may_straggle = self.faults.may_straggle
 
+        # Client population registry (dopt.population): the gossip-side
+        # integration is cohort→lane DATA binding — each round the
+        # stateless sampler binds ``n`` population clients onto the n
+        # lanes, so lane i trains client c_i's assigned shard under
+        # client c_i's batch stream while the consensus state stays
+        # lane-resident (a sampled client inherits the lane's current
+        # model from its previous occupant, the decentralized-FL
+        # hand-off).  Client-keyed FAULT identity is a federated-engine
+        # feature: gossip's crash/corrupt/link machinery is lane-keyed
+        # throughout, so composing it with a per-round client rebinding
+        # would silently change what "worker i" means — rejected loudly
+        # instead.  population=None compiles the exact pre-change
+        # programs.
+        self._registry = None
+        if cfg.population is not None:
+            from dopt.population import (ClientRegistry,
+                                         validate_population_config)
+
+            pop = cfg.population
+            validate_population_config(pop)
+            if pop.cohort != w:
+                raise ValueError(
+                    f"gossip population mode trains every lane every "
+                    f"round: set cohort == data.num_users "
+                    f"(cohort={pop.cohort}, num_users={w}); wave-looped "
+                    "cohorts are a federated-engine feature")
+            if pop.lanes not in (None, w):
+                raise ValueError(
+                    f"gossip population mode binds onto the fixed "
+                    f"{w}-lane fleet; lanes={pop.lanes} is a federated-"
+                    "engine knob")
+            if has_faults or g.dropout > 0:
+                raise ValueError(
+                    "gossip population mode does not compose with fault "
+                    "injection (gossip fault identity is lane-keyed; a "
+                    "per-round client rebinding would silently change "
+                    "what 'worker i' means) — use the federated engine "
+                    "for client-keyed faults")
+            if cfg.robust is not None and (cfg.robust.clip_radius > 0
+                                           or cfg.robust.quarantine_after
+                                           > 0):
+                raise ValueError(
+                    "gossip population mode does not compose with the "
+                    "robust layer (screen/quarantine identity is lane-"
+                    "keyed, and its ledger rows would interleave "
+                    "differently under blocked execution) — the "
+                    "federated engine is the client-keyed path")
+            if cfg.data.local_holdout > 0:
+                raise ValueError(
+                    "gossip population mode is incompatible with the "
+                    "local holdout (per-epoch client rows are lane-"
+                    "keyed) — drop one of the two")
+            self._registry = ClientRegistry(pop, num_shards=w,
+                                            seed=cfg.seed, lanes=w)
+
         # Byzantine threat model (dopt.robust): workers can LIE on the
         # wire — their broadcast state is corrupted inside the jitted
         # round — and the defense is clipped gossip (every neighbor
@@ -1132,13 +1187,7 @@ class GossipTrainer:
                     cmasks = (np.stack([p[3] for p in pairs])
                               if self._has_corrupt else None)
                     frows = [p[4] for p in pairs]
-                plans = [
-                    make_batch_plan(self._plan_matrix_for_round(t),
-                                    batch_size=g.local_bs,
-                                    local_ep=g.local_ep, seed=cfg.seed,
-                                    round_idx=t, impl=cfg.data.plan_impl)
-                    for t in ts
-                ]
+                plans = [self._round_plan(t) for t in ts]
                 idx = jax.device_put(np.stack([p.idx for p in plans]),
                                      block_sharding)
                 bw = jax.device_put(np.stack([p.weight for p in plans]),
@@ -1418,6 +1467,32 @@ class GossipTrainer:
     def _plan_matrix_for_round(self, t: int) -> np.ndarray:
         return self.faults.plan_matrix_for(t, self._train_matrix)
 
+    def _round_plan(self, t: int):
+        """Round t's batch plan: the classic per-lane plan, or — in
+        population mode — the sampled cohort bound onto the lanes (lane
+        i trains client c_i's shard under client c_i's batch stream;
+        sampling is stateless per (seed, round), so blocked and resumed
+        runs bind identical cohorts).  Appends the round's ``cohort``
+        audit row and updates the registry's participation counters as
+        a side effect."""
+        cfg, g = self.cfg, self.cfg.gossip
+        if self._registry is None:
+            return make_batch_plan(
+                self._plan_matrix_for_round(t), batch_size=g.local_bs,
+                local_ep=g.local_ep, seed=cfg.seed, round_idx=t,
+                impl=cfg.data.plan_impl)
+        reg = self._registry
+        cohort = reg.sample_cohort(t)
+        binding = reg.bind(t, cohort, cohort)
+        ids = binding.lane_ids[0]
+        reg.record_participation(t, binding.survivors)
+        self.history.faults.append(binding.ledger_row(reg.clients))
+        return make_batch_plan(
+            self._train_matrix, batch_size=g.local_bs,
+            local_ep=g.local_ep, seed=cfg.seed, round_idx=t,
+            impl=cfg.data.plan_impl, workers=ids,
+            rows=reg.shard_of[ids])
+
     def _apply_screen_feedback(self, t: int, alive, flags,
                                rows: list) -> None:
         """Fold the device step's screened-sender flags (non-finite or
@@ -1478,11 +1553,7 @@ class GossipTrainer:
             with self.timers.phase("host_batch_plan"):
                 w_t, alive, limits, cmask, frows, quar = \
                     self._round_inputs(t)
-                plan = make_batch_plan(
-                    self._plan_matrix_for_round(t), batch_size=g.local_bs,
-                    local_ep=g.local_ep,
-                    seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
-                )
+                plan = self._round_plan(t)
                 idx = jax.device_put(plan.idx, self._sharding)
                 bweight = jax.device_put(plan.weight, self._sharding)
             do_eval = (t % self.eval_every) == 0
@@ -1558,18 +1629,17 @@ class GossipTrainer:
                 arrays["link_buf"] = self._link_buf
                 if self._push_sum:
                     arrays["link_buf_mass"] = {"mass": self._link_buf_mass}
-        save_checkpoint(
-            path,
-            arrays=arrays,
-            meta={"round": self.round, "name": self.cfg.name,
-                  "algorithm": self.cfg.gossip.algorithm,
-                  "history": self.history.rows,
-                  "client_history": self.client_history.rows,
-                  "fault_ledger": self.history.faults,
-                  "screen_streak": self._screen_streak.tolist(),
-                  "quarantine_until": self._quarantine_until.tolist(),
-                  "matching_rng_state": self._matching_rng.bit_generator.state},
-        )
+        meta = {"round": self.round, "name": self.cfg.name,
+                "algorithm": self.cfg.gossip.algorithm,
+                "history": self.history.rows,
+                "client_history": self.client_history.rows,
+                "fault_ledger": self.history.faults,
+                "screen_streak": self._screen_streak.tolist(),
+                "quarantine_until": self._quarantine_until.tolist(),
+                "matching_rng_state": self._matching_rng.bit_generator.state}
+        if self._registry is not None:
+            meta["population_registry"] = self._registry.state_dict()
+        save_checkpoint(path, arrays=arrays, meta=meta)
 
     def restore(self, path) -> None:
         """Resume from a checkpoint written by ``save`` (same config)."""
@@ -1631,6 +1701,14 @@ class GossipTrainer:
             meta.get("quarantine_until", [0] * w), np.int64)
         if meta.get("matching_rng_state"):
             self._matching_rng.bit_generator.state = meta["matching_rng_state"]
+        if self._registry is not None:
+            state = meta.get("population_registry")
+            if state is None:
+                raise ValueError(
+                    "population-mode trainer requires its registry state "
+                    "('population_registry') in the checkpoint — this "
+                    "checkpoint is from a lane-engine run")
+            self._registry.load_state(state)
         if meta.get("dropout_rng_state"):
             # Checkpoint from before dropout joined FaultPlan, whose
             # draws are stateless per round: the resumed run's failure

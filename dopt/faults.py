@@ -63,7 +63,17 @@ _CRASH, _STRAGGLE, _PARTITION, _CORRUPT = 1, 2, 3, 4
 _LINK, _UPLINK, _CHURN, _STALE = 5, 6, 7, 8
 
 KINDS = ("crash", "straggler", "partition", "overselect", "corrupt",
-         "quarantine", "msg_drop", "msg_delay", "churn", "staleness")
+         "quarantine", "msg_drop", "msg_delay", "churn", "staleness",
+         "cohort")
+# "cohort" (dopt.population): one row per population-sampled round —
+# {round, worker: -1, kind: "cohort", action:
+# "sampled_{m}_of_{P}_digest_{crc32}_waves_{K}"} — so which clients a
+# round drew is auditable (and replayable via the digest) exactly like
+# every injected fault.  FaultPlan itself is population-size agnostic:
+# the registry constructs it with num_workers = P so every stateless
+# per-round draw (crash/corrupt/churn/uplink/...) is keyed by CLIENT
+# id, which is what makes corrupt_max-pinned adversaries persist
+# across cohorts instead of being reshuffled with the lane binding.
 CORRUPT_MODES = ("nan", "inf", "scale", "signflip", "stale")
 
 # The GossipConfig.dropout alias predates FaultPlan; warn once per

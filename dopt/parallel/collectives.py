@@ -482,17 +482,25 @@ def mix_update_scatter(stacked, arg, mesh: Mesh, spec: UpdateShardSpec,
 
 
 def masked_average_scatter(stacked, mask, mesh: Mesh,
-                           spec: UpdateShardSpec):
+                           spec: UpdateShardSpec, denom=None):
     """Sharded-update formulation of ``masked_average`` (Xu et al.,
     arXiv:2004.13336): each device reduces its local lanes' masked
     partial sum per bucket, ``psum_scatter`` leaves each device owning
     a 1/D shard of the flat sum, the aggregation update (the divide)
     runs on that shard only, and ONE tiled all-gather re-forms the
     replicated θ — instead of every device redundantly computing the
-    full |θ| average.  Returns the unstacked θ tree."""
+    full |θ| average.  Returns the unstacked θ tree.
+
+    ``denom`` (optional traced scalar) overrides the divisor: the
+    hierarchical-aggregation path (``dopt.population``) accumulates
+    per-lane weighted sums over multiple cohort WAVES and then needs
+    Σ_lanes acc / total_cohort_weight — the lane mask alone no longer
+    knows the true weight, so the caller supplies it (already guarded
+    against zero)."""
     ax = _require_flat_mesh(mesh, "update_sharding='scatter'")
     m = jnp.asarray(mask, dtype=jnp.float32)
-    denom = jnp.maximum(m.sum(), 1.0)
+    denom = (jnp.maximum(m.sum(), 1.0) if denom is None
+             else jnp.asarray(denom, jnp.float32))
     buckets = stacked_to_buckets(stacked, spec)
 
     def per_device(mask_l, x):

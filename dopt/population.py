@@ -1,0 +1,337 @@
+"""Client population registry: cohort sampling over 1k–10k clients.
+
+Both engines historically equated "worker" with "device lane": the
+fleet topped out at the lane count the mesh could fold (16 workers on
+8 devices).  Production cross-device FL samples each round's cohort
+from a population orders of magnitude larger — most clients are idle
+most of the time, and the interesting per-client state (which data
+shard it owns, how often it participated, whether it is serving a
+quarantine sentence) is kilobytes of host-side bookkeeping, not a
+device lane.  This module makes that population a first-class,
+host-side state object decoupled from the fixed-width lanes:
+
+* **Registry** — per-client arrays for P clients: data-shard
+  assignment (``dopt.data.partition.assign_client_shards``),
+  participation counts, last-sampled round (the staleness signal),
+  non-finite screen streaks and quarantine sentences — all keyed by
+  CLIENT id, so a ``corrupt_max``-pinned adversary or a quarantine
+  sentence persists across cohorts instead of being reshuffled with
+  the lane binding.  The registry owns a client-keyed ``FaultPlan``
+  (``num_workers = P``): every stateless per-round draw — crash,
+  straggle, corrupt, churn, uplink loss — is a [P] vector gathered at
+  the cohort's ids.
+* **Cohort sampler** — seeded and STATELESS: round t's cohort is a
+  function of (seed, t, eligible set) alone, drawn without replacement
+  from the clients that are neither quarantined nor churned away.  No
+  RNG state is carried between rounds, so sampling is bit-reproducible,
+  identical under blocked execution, and crash-exact under resume
+  without persisting generator state.
+* **Cohort→lane binding** — the M sampled survivors are packed into
+  ``ceil(cohort / lanes)`` fixed-width WAVES of the engine's
+  validity-masked lanes (survivors first, wraparound padding ids,
+  validity as data — the PR-4 "survivor counts are data, not shapes"
+  machinery), so cohort size never retraces: one compiled program
+  serves every round of a population run.
+* **Hierarchical aggregation** — the engine scans the waves inside one
+  jitted round: each wave trains ``lanes`` stateless clients from
+  theta, per-device partial weighted sums accumulate across waves in
+  f32, and ONE cross-device bucketed reduce
+  (``dopt.parallel.collectives.masked_average_scatter`` with an
+  explicit cohort-weight denominator) forms the aggregate — the
+  per-device-partials → one-reduce tree of "Improving Efficiency in
+  Large-Scale Decentralized Distributed Training" (arXiv:2002.01119)
+  riding the arXiv:2004.13336 bucketed flat-tree substrate from PR 6.
+
+Every sampled round lands one ``cohort`` row in the fault ledger
+({round, worker: -1, kind: "cohort", action:
+"sampled_{m}_of_{P}_digest_{crc32}_waves_{K}"}), so sampling is
+auditable and replay-checkable like every fault kind.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from dopt.config import FaultConfig, PopulationConfig, RobustConfig
+from dopt.data.partition import assign_client_shards, orphan_shard_adopters
+from dopt.faults import FaultPlan
+from dopt.robust import quarantine_step
+from dopt.utils.prng import host_rng
+
+# Salt for the stateless cohort draws — its own namespace so arming the
+# population registry never perturbs the fault or lane-sampling streams.
+_COHORT_SALT = 0xC0407
+
+
+def validate_population_config(cfg: PopulationConfig) -> None:
+    if cfg.clients < 1:
+        raise ValueError(
+            f"PopulationConfig.clients={cfg.clients} must be >= 1")
+    if not 1 <= cfg.cohort <= cfg.clients:
+        raise ValueError(
+            f"PopulationConfig.cohort={cfg.cohort} must be in "
+            f"[1, clients={cfg.clients}]")
+    if cfg.lanes is not None and cfg.lanes < 1:
+        raise ValueError(
+            f"PopulationConfig.lanes={cfg.lanes} must be >= 1")
+
+
+def cohort_digest(ids: np.ndarray) -> str:
+    """8-hex-char CRC32 of the cohort's SORTED client ids — the ledger's
+    compact, order-independent audit key for "which clients did round t
+    draw" (two runs disagree on sampling iff some digest differs)."""
+    ids = np.sort(np.asarray(ids, np.int64))
+    return f"{zlib.crc32(ids.tobytes()) & 0xFFFFFFFF:08x}"
+
+
+class CohortBinding:
+    """One round's cohort packed onto the fixed lane grid.
+
+    ``lane_ids`` is the [waves, lanes] int32 client-id grid (survivors
+    first in sorted order, wraparound padding after), ``valid`` the
+    matching 0/1 f32 validity mask — the device program consumes both
+    as DATA, so every cohort size (including zero survivors) shares one
+    compiled program."""
+
+    def __init__(self, round_: int, cohort: np.ndarray,
+                 survivors: np.ndarray, lanes: int, waves: int):
+        self.round = int(round_)
+        self.cohort = np.asarray(cohort, np.int64)
+        self.survivors = np.asarray(survivors, np.int64)
+        self.lanes = int(lanes)
+        self.waves = int(waves)
+        slots = self.waves * self.lanes
+        n = len(self.survivors)
+        if n > slots:
+            raise ValueError(
+                f"{n} survivors exceed the {self.waves}x{self.lanes} "
+                "lane grid")
+        if n:
+            pad = self.survivors[np.arange(n, slots) % n]
+            grid = np.concatenate([self.survivors, pad])
+        else:
+            grid = np.zeros(slots, np.int64)
+        self.lane_ids = grid.reshape(self.waves, self.lanes).astype(np.int32)
+        valid = np.zeros(slots, np.float32)
+        valid[:n] = 1.0
+        self.valid = valid.reshape(self.waves, self.lanes)
+
+    @property
+    def digest(self) -> str:
+        return cohort_digest(self.cohort)
+
+    def ledger_row(self, population: int) -> dict:
+        """The round's ``cohort`` audit row (worker −1: a fleet-level
+        event, not any one client's)."""
+        return {"round": self.round, "worker": -1, "kind": "cohort",
+                "action": (f"sampled_{len(self.cohort)}_of_{population}"
+                           f"_digest_{self.digest}_waves_{self.waves}")}
+
+
+class ClientRegistry:
+    """Host-side per-client state for a population of P clients.
+
+    All arrays are plain numpy keyed by client id; the only
+    round-to-round state is what ``state_dict`` checkpoints (sampling
+    itself is stateless).  The registry is engine-agnostic: the
+    federated trainer drives the full participate→train→screen cycle,
+    the gossip trainer uses the sampler + shard binding only."""
+
+    def __init__(self, cfg: PopulationConfig, *, num_shards: int,
+                 seed: int, faults: FaultConfig | None = None,
+                 robust: RobustConfig | None = None,
+                 lanes: int | None = None):
+        validate_population_config(cfg)
+        self.cfg = cfg
+        self.clients = int(cfg.clients)
+        self.cohort_size = int(cfg.cohort)
+        self.num_shards = int(num_shards)
+        self.seed = int(cfg.seed) if cfg.seed is not None else int(seed)
+        self.lanes = int(lanes if lanes is not None
+                         else (cfg.lanes or num_shards))
+        if self.lanes < 1:
+            raise ValueError(f"lane width {self.lanes} must be >= 1")
+        # Static wave count: the lane grid always holds the FULL
+        # configured cohort; short cohorts (quarantine/churn dips) ride
+        # the validity mask instead of reshaping the program.
+        self.waves = -(-self.cohort_size // self.lanes)
+        self.shard_of = assign_client_shards(self.clients, self.num_shards,
+                                             seed=self.seed)
+        # Client-keyed fault streams: the SAME FaultPlan machinery the
+        # lane engines use, sized to the population — so corrupt=1.0 +
+        # corrupt_max=f pins CLIENTS 0..f-1 as persistent adversaries
+        # across every cohort that samples them.
+        self.faults = FaultPlan(self.clients, faults, seed=seed)
+        self._quarantine_after = (int(robust.quarantine_after)
+                                  if robust is not None else 0)
+        self._quarantine_rounds = (int(robust.quarantine_rounds)
+                                   if robust is not None else 0)
+        self.participation = np.zeros(self.clients, np.int64)
+        self.last_sampled = np.full(self.clients, -1, np.int64)
+        self.screen_streak = np.zeros(self.clients, np.int64)
+        self.quarantine_until = np.zeros(self.clients, np.int64)
+
+    # -- eligibility & sampling ----------------------------------------
+    def staleness(self, t: int) -> np.ndarray:
+        """[P] rounds since each client last participated (t+1 for the
+        never-sampled) — the registry's per-client staleness signal."""
+        return np.where(self.last_sampled < 0, int(t) + 1,
+                        int(t) - self.last_sampled)
+
+    def begin_round(self, t: int) -> list[dict]:
+        """Expire quarantine sentences due at round t; returns the
+        readmission ledger rows (client-keyed)."""
+        rows: list[dict] = []
+        expired = (self.quarantine_until != 0) & (t >= self.quarantine_until)
+        for i in np.nonzero(expired)[0]:
+            rows.append({"round": int(t), "worker": int(i),
+                         "kind": "quarantine", "action": "readmitted"})
+            self.quarantine_until[i] = 0
+            self.screen_streak[i] = 0
+        return rows
+
+    def eligible(self, t: int) -> np.ndarray:
+        """[P] bool: clients neither serving a quarantine sentence nor
+        churned away at round t."""
+        ok = ~(self.quarantine_until > t)
+        away = self.faults.away_for_round(t)
+        return ok & ~away
+
+    def sample_cohort(self, t: int, *, n_draw: int | None = None,
+                      eligible: np.ndarray | None = None) -> np.ndarray:
+        """Round t's cohort draw, in DRAW order (the over-selection
+        surplus must release uniformly — sorting happens at binding).
+        Stateless: keyed by (seed, round) over the eligible ids, so a
+        resumed run draws exactly what a continuous run would.  Returns
+        min(n_draw, #eligible) ids; an empty draw is a valid (skipped)
+        round, not an error."""
+        if eligible is None:
+            eligible = self.eligible(t)
+        ids = np.nonzero(eligible)[0]
+        n = min(int(n_draw if n_draw is not None else self.cohort_size),
+                len(ids))
+        if n == 0:
+            return np.zeros(0, np.int64)
+        rng = host_rng(self.seed, _COHORT_SALT, int(t))
+        return np.asarray(rng.choice(ids, n, replace=False), np.int64)
+
+    def bind(self, t: int, cohort: np.ndarray,
+             survivors: np.ndarray) -> CohortBinding:
+        """Pack the round's survivors (sorted) onto the lane grid."""
+        return CohortBinding(t, cohort, np.sort(np.asarray(survivors)),
+                             self.lanes, self.waves)
+
+    def churn_ledger_rows(self, t: int, away: np.ndarray) -> list[dict]:
+        """Population-keyed elastic-membership rows for round t:
+        per-CLIENT leave/rejoin transitions plus per-SHARD adoption
+        changes (worker −1: a shard is a fleet-level resource).  The
+        worker-level ``dopt.faults.churn_ledger_rows`` cannot be reused
+        here — its ``adopters_for`` assumes worker i OWNS shard i,
+        which at population scale would fabricate client-id adoption
+        rows while the real orphan-shard adoptions
+        (``orphan_shard_adopters``, the map ``plan_matrix_for``
+        actually applies) went unledgered.  Stateless in the round
+        index, so per-round and resumed runs log identically."""
+        rows: list[dict] = []
+        prev = (self.faults.away_for_round(t - 1) if t > 0
+                else np.zeros_like(away))
+        for i in np.nonzero(away & ~prev)[0]:
+            rows.append({"round": int(t), "worker": int(i),
+                         "kind": "churn", "action": "left"})
+        for i in np.nonzero(prev & ~away)[0]:
+            rows.append({"round": int(t), "worker": int(i),
+                         "kind": "churn", "action": "rejoined"})
+        cur = orphan_shard_adopters(self.shard_of, ~away, self.num_shards)
+        prv = orphan_shard_adopters(self.shard_of, ~prev, self.num_shards)
+        for s, a in sorted(cur.items()):
+            if prv.get(s) != a:
+                rows.append({"round": int(t), "worker": -1,
+                             "kind": "churn",
+                             "action": f"shard_{s}_adopted_by_{a}"})
+        return rows
+
+    # -- data binding ---------------------------------------------------
+    def plan_matrix_for(self, t: int,
+                        train_matrix: np.ndarray) -> np.ndarray:
+        """Round t's batch-plan index matrix: ``train_matrix`` with any
+        ORPHANED shard (every assigned client churned away) adopted by
+        the next covered shard — the population-level analog of the
+        worker-level ``FaultPlan.plan_matrix_for``."""
+        if not self.faults.has_churn:
+            return train_matrix
+        from dopt.data.partition import reassign_shards
+
+        alive = ~self.faults.away_for_round(t)
+        adopters = orphan_shard_adopters(self.shard_of, alive,
+                                         self.num_shards)
+        return reassign_shards(train_matrix, adopters)
+
+    # -- feedback -------------------------------------------------------
+    def record_participation(self, t: int, ids: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64)
+        self.participation[ids] += 1
+        self.last_sampled[ids] = int(t)
+
+    def apply_screen_feedback(self, t: int, ids: np.ndarray,
+                              flags: np.ndarray, rows: list) -> None:
+        """Fold the device round's non-finite-screen flags (aligned with
+        ``ids``, the round's surviving clients) into the client-keyed
+        ledger + quarantine streaks — the engines' rule
+        (``dopt.robust.quarantine_step``) applied at population scale."""
+        for j, cid in enumerate(np.asarray(ids).reshape(-1)):
+            if float(flags[j]) > 0.5:
+                rows.append({"round": int(t), "worker": int(cid),
+                             "kind": "corrupt",
+                             "action": "screened_nonfinite"})
+        sentenced = quarantine_step(
+            self.screen_streak, self.quarantine_until, ids, flags, t,
+            after=self._quarantine_after, rounds=self._quarantine_rounds)
+        for cid, until in sentenced:
+            rows.append({"round": int(t), "worker": int(cid),
+                         "kind": "quarantine",
+                         "action": f"quarantined_until_{until}"})
+
+    # -- checkpointing --------------------------------------------------
+    def state_dict(self) -> dict:
+        """JSON-able registry state (everything that is not a stateless
+        function of the round index).  ``shard_of`` rides along as an
+        integrity check — a resumed run must see the identical
+        assignment or its cohorts would silently train different data."""
+        return {
+            "clients": self.clients,
+            "cohort": self.cohort_size,
+            "lanes": self.lanes,
+            "participation": self.participation.tolist(),
+            "last_sampled": self.last_sampled.tolist(),
+            "screen_streak": self.screen_streak.tolist(),
+            "quarantine_until": self.quarantine_until.tolist(),
+            "shard_of": self.shard_of.tolist(),
+        }
+
+    def load_state(self, state: dict) -> None:
+        for key, expect in (("clients", self.clients),
+                            ("cohort", self.cohort_size),
+                            ("lanes", self.lanes)):
+            got = state.get(key)
+            if got is not None and int(got) != expect:
+                raise ValueError(
+                    f"checkpoint registry {key}={got} does not match the "
+                    f"trainer's {key}={expect}")
+        p = self.clients
+        self.participation = np.asarray(
+            state.get("participation", [0] * p), np.int64)
+        self.last_sampled = np.asarray(
+            state.get("last_sampled", [-1] * p), np.int64)
+        self.screen_streak = np.asarray(
+            state.get("screen_streak", [0] * p), np.int64)
+        self.quarantine_until = np.asarray(
+            state.get("quarantine_until", [0] * p), np.int64)
+        saved = state.get("shard_of")
+        if saved is not None and not np.array_equal(
+                np.asarray(saved, np.int32), self.shard_of):
+            raise ValueError(
+                "checkpoint registry shard assignment differs from this "
+                "trainer's (population/shards/seed mismatch) — resuming "
+                "would train different data per client")

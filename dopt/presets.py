@@ -21,7 +21,8 @@ import dataclasses
 
 from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
                          FederatedConfig, GossipConfig, ModelConfig,
-                         OptimizerConfig, RobustConfig, SeqLMConfig)
+                         OptimizerConfig, PopulationConfig, RobustConfig,
+                         SeqLMConfig)
 
 MNIST_TRAIN, MNIST_TEST = 60_000, 10_000
 CIFAR_TRAIN, CIFAR_TEST = 50_000, 10_000
@@ -285,6 +286,16 @@ PRESETS = {
                                    correction="push_sum"),
         faults=FaultConfig(msg_drop=0.15, msg_delay=0.2, msg_delay_max=2,
                            churn=0.02, churn_span=3, crash=0.05)),
+    # Client-scale variant (dopt.population): the baseline3 workload
+    # with the worker==lane equation broken — a 1000-client registry
+    # sampling a 64-client cohort each round onto the 16 data-shard
+    # lanes (4 waves, hierarchical aggregation: per-device partial sums
+    # across waves → one bucketed reduce-scatter).  Scale it with
+    # --clients/--cohort, e.g. `--clients 10000 --cohort 256`.
+    "baseline3-xclients": lambda: dataclasses.replace(
+        baseline_3_fedavg_noniid(),
+        name="baseline3-fedavg-xclients-1k",
+        population=PopulationConfig(clients=1000, cohort=64)),
     "baseline3-elastic": lambda: dataclasses.replace(
         baseline_3_fedavg_noniid(),
         name="baseline3-fedavg16-noniid-elastic",

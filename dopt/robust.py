@@ -43,6 +43,7 @@ import operator
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 AGGREGATORS = ("mean", "trimmed_mean", "median", "krum", "multi_krum")
 
@@ -359,3 +360,37 @@ def clipped_gossip_mix(x, x_send, w_matrix, tau: float):
             / jnp.maximum(edges.sum(axis=0), 1).astype(jnp.float32))
     screened = jnp.maximum((frac > 0.5).astype(jnp.float32), 1.0 - fin)
     return mixed, screened
+
+
+# ---------------------------------------------------------------------
+# Quarantine bookkeeping (shared streak/sentence rule)
+# ---------------------------------------------------------------------
+
+def quarantine_step(streak: np.ndarray, until: np.ndarray,
+                    ids: np.ndarray, flags: np.ndarray, t: int, *,
+                    after: int, rounds: int) -> list[tuple[int, int]]:
+    """One host-side detection/quarantine update over identity arrays:
+    K consecutive screened participations → benched for ``rounds``; one
+    clean participation resets the streak.  The same rule the engines'
+    lane-keyed machinery applies (their inline copies are load-bearing
+    — each is mirrored by a jnp scan-carry twin and pinned to exact
+    ledger row ORDER, so they stay hand-rolled); the client registry's
+    population-keyed state (``dopt.population``) calls this directly.
+
+    ``streak``/``until`` are the identity-indexed int arrays (mutated
+    in place); ``ids`` the identities that PARTICIPATED this round with
+    their 0/1 ``flags``.  ``after`` <= 0 disables sentencing (streaks
+    still track).  Returns [(id, until)] for the identities quarantined
+    THIS call, so the caller can ledger them."""
+    sentenced: list[tuple[int, int]] = []
+    for j, wid in enumerate(np.asarray(ids).reshape(-1)):
+        wid = int(wid)
+        if float(flags[j]) > 0.5:
+            streak[wid] += 1
+            if after > 0 and streak[wid] >= after:
+                until[wid] = int(t) + 1 + int(rounds)
+                streak[wid] = 0
+                sentenced.append((wid, int(until[wid])))
+        else:
+            streak[wid] = 0
+    return sentenced

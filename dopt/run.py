@@ -147,6 +147,21 @@ def main(argv: list[str] | None = None) -> int:
                          "defense is clipped gossip: pass "
                          "'--aggregator mean --set robust.clip_radius=R' "
                          "(the flag installs the robust section)")
+    ap.add_argument("--clients", type=int, default=None, metavar="N",
+                    help="client population registry (dopt.population): "
+                         "sample each round's cohort from N host-side "
+                         "client records instead of equating workers with "
+                         "device lanes; the cohort trains in "
+                         "ceil(cohort/lanes) waves with hierarchical "
+                         "(bucketed reduce-scatter) aggregation.  Pair "
+                         "with --cohort/--cohort-seed; tune the lane "
+                         "width with --set population.lanes=W")
+    ap.add_argument("--cohort", type=int, default=None, metavar="M",
+                    help="clients sampled per round (default 64; requires "
+                         "--clients or a population preset)")
+    ap.add_argument("--cohort-seed", type=int, default=None, metavar="S",
+                    help="cohort-sampler seed (default: the experiment "
+                         "seed); draws are stateless per (seed, round)")
     ap.add_argument("--faults-json", default=None, metavar="PATH",
                     help="write the run's fault ledger here as JSON")
     ap.add_argument("--timers", action="store_true",
@@ -205,6 +220,35 @@ def main(argv: list[str] | None = None) -> int:
         # ledger the user believes is a faulted run.
         raise SystemExit("fault injection is supported by the "
                          "federated/gossip jax engines only")
+    if (args.clients is not None or args.cohort is not None
+            or args.cohort_seed is not None):
+        from dopt.config import PopulationConfig
+        from dopt.population import validate_population_config
+
+        base_pop = cfg.population
+        if args.clients is None and base_pop is None:
+            raise SystemExit("--cohort/--cohort-seed need --clients N (or "
+                             "a preset with a population section)")
+        pop_kw = {}
+        if args.clients is not None:
+            pop_kw["clients"] = args.clients
+        if args.cohort is not None:
+            pop_kw["cohort"] = args.cohort
+        if args.cohort_seed is not None:
+            pop_kw["seed"] = args.cohort_seed
+        pop = dataclasses.replace(base_pop or PopulationConfig(), **pop_kw)
+        try:
+            validate_population_config(pop)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        cfg = cfg.replace(population=pop)
+    if cfg.population is not None and (cfg.seqlm is not None
+                                       or cfg.backend == "torch"):
+        # Same contract as faults: the torch oracle and seqlm engines
+        # never read cfg.population — reject instead of silently running
+        # the classic worker==lane experiment.
+        raise SystemExit("the client population registry is supported by "
+                         "the federated/gossip jax engines only")
     if args.num_users is not None:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data,
                                                    num_users=args.num_users))

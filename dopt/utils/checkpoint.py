@@ -8,7 +8,14 @@ ADMM duals, global model, round counter, and metric history — with
 orbax for the array pytrees plus a JSON sidecar for scalars/history.
 
 Layout:  <dir>/state/   orbax pytree checkpoint
-         <dir>/meta.json  {round, name, history rows}
+         <dir>/meta.json  {round, name, history rows, fault ledger,
+                           quarantine/staleness host mirrors, and — for
+                           population runs (dopt.population) — the
+                           client registry under 'population_registry'
+                           (participation counts, client-keyed streaks
+                           and sentences, shard-assignment integrity
+                           vector; the cohort sampler is stateless, so
+                           no RNG state rides along)}
 
 Saves are atomic: the new checkpoint is fully materialised in a
 ``<dir>.tmp`` sibling, the previous checkpoint (if any) is parked at
@@ -161,6 +168,27 @@ def load_checkpoint(path: str | Path) -> tuple[dict[str, Any], dict[str, Any]]:
         with np.load(path / "state.npz") as z:
             arrays = _unflatten_from_npz(dict(z))
     return arrays, meta
+
+
+def meta_expect(meta: dict[str, Any], *, what: str = "checkpoint",
+                **expected: Any) -> None:
+    """Validate checkpoint metadata fields against expected values.
+
+    Collects EVERY mismatching (or absent-but-expected) field into one
+    ValueError instead of failing on the first, so a wrong-config
+    resume reports the whole disagreement at once.  Fields the
+    checkpoint predates (absent AND expected None) pass — older
+    checkpoints stay loadable."""
+    problems = []
+    for key, want in expected.items():
+        got = meta.get(key)
+        if got is None and want is None:
+            continue
+        if got != want:
+            problems.append(f"{key}={got!r} (trainer expects {want!r})")
+    if problems:
+        raise ValueError(
+            f"{what} does not match this trainer: " + "; ".join(problems))
 
 
 def _flatten_for_npz(tree, prefix="") -> dict[str, np.ndarray]:

@@ -1,0 +1,510 @@
+"""Client population registry (dopt.population): cohort sampling over
+1k–10k clients with hierarchical (multi-wave) aggregation.
+
+Tier-1 pins, in dependency order:
+
+* registry units — shard assignment, orphan adoption, stateless sampler
+  determinism (a freshly constructed registry redraws the identical
+  cohorts — the restart contract), digest stability, binding shapes;
+* the cohort-vs-flat PARITY contract: a 64-client population with
+  cohort 64 on 8 lanes × 8 waves reproduces the 64-lane flat engine's
+  aggregate to f32-allclose (momentum 0 — population clients are
+  stateless; the flat run's zero-momentum update is too, so the two
+  paths differ only by summation association);
+* per-client quarantine persistence across cohorts (adversaries are
+  CLIENT ids, not lane slots);
+* mid-run kill-and-resume bit-identity (stateless sampler + registry
+  state in the checkpoint);
+* the ``cohort`` ledger kind round-trips through
+  ``History.faults_from_json`` like every fault kind.
+
+Engine runs use the mlp model + tiny synthetic data (tier-1 budget);
+the 10k-client sweep is marked slow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig, PopulationConfig, RobustConfig)
+from dopt.data.partition import (assign_client_shards,
+                                 orphan_shard_adopters)
+from dopt.data.pipeline import make_batch_plan
+from dopt.population import (ClientRegistry, cohort_digest,
+                             validate_population_config)
+from dopt.utils.metrics import History
+
+pytestmark = pytest.mark.population
+
+
+# ---------------------------------------------------------------------
+# Config helpers (mlp + tiny synthetic data — tier-1 budget)
+# ---------------------------------------------------------------------
+
+def _fed_cfg(*, clients, cohort, lanes=None, num_users=8, seed=7,
+             momentum=0.5, train=320, rounds=3, faults=None, robust=None,
+             local_bs=16, pop_seed=None, algorithm="fedavg"):
+    return ExperimentConfig(
+        name="test-pop", seed=seed,
+        data=DataConfig(dataset="synthetic", num_users=num_users, iid=True,
+                        synthetic_train_size=train,
+                        synthetic_test_size=64),
+        model=ModelConfig(model="mlp", faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=momentum),
+        federated=FederatedConfig(algorithm=algorithm, frac=0.5,
+                                  rounds=rounds, local_ep=1,
+                                  local_bs=local_bs),
+        faults=faults, robust=robust,
+        population=PopulationConfig(clients=clients, cohort=cohort,
+                                    lanes=lanes, seed=pop_seed),
+    )
+
+
+def _train(cfg, rounds):
+    from dopt.engine.federated import FederatedTrainer
+
+    tr = FederatedTrainer(cfg)
+    tr.run(rounds=rounds)
+    return tr
+
+
+# ---------------------------------------------------------------------
+# Registry units
+# ---------------------------------------------------------------------
+
+def test_assign_client_shards():
+    a = assign_client_shards(10, 4)
+    assert a.tolist() == [0, 1, 2, 3, 0, 1, 2, 3, 0, 1]
+    # population == shards -> the identity map (the parity contract's
+    # precondition).
+    assert assign_client_shards(6, 6).tolist() == list(range(6))
+    r = assign_client_shards(1000, 16, seed=3, mode="random")
+    counts = np.bincount(r, minlength=16)
+    assert counts.max() - counts.min() <= 1           # still balanced
+    assert not np.array_equal(r, assign_client_shards(1000, 16))
+    assert np.array_equal(r, assign_client_shards(1000, 16, seed=3,
+                                                  mode="random"))
+    with pytest.raises(ValueError, match="unknown client-shard"):
+        assign_client_shards(4, 2, mode="hash")
+    with pytest.raises(ValueError, match="population"):
+        assign_client_shards(0, 2)
+
+
+def test_orphan_shard_adopters():
+    # 6 clients on 3 shards; shard 1's clients (1, 4) both away.
+    assignment = assign_client_shards(6, 3)
+    alive = np.array([True, False, True, True, False, True])
+    assert orphan_shard_adopters(assignment, alive, 3) == {1: 2}
+    # Everyone alive / everyone away -> no adoption.
+    assert orphan_shard_adopters(assignment, np.ones(6, bool), 3) == {}
+    assert orphan_shard_adopters(assignment, np.zeros(6, bool), 3) == {}
+
+
+def test_sampler_determinism_across_restarts():
+    pop = PopulationConfig(clients=200, cohort=16)
+    a = ClientRegistry(pop, num_shards=8, seed=11)
+    b = ClientRegistry(pop, num_shards=8, seed=11)   # "restarted" process
+    for t in range(5):
+        ca, cb = a.sample_cohort(t), b.sample_cohort(t)
+        assert np.array_equal(ca, cb)
+        assert len(np.unique(ca)) == 16              # without replacement
+    assert not np.array_equal(a.sample_cohort(0), a.sample_cohort(1))
+    # A different sampler seed redraws a different stream.
+    c = ClientRegistry(PopulationConfig(clients=200, cohort=16, seed=99),
+                       num_shards=8, seed=11)
+    assert not np.array_equal(a.sample_cohort(0), c.sample_cohort(0))
+
+
+def test_sampler_respects_eligibility():
+    pop = PopulationConfig(clients=20, cohort=8)
+    reg = ClientRegistry(pop, num_shards=4, seed=0)
+    reg.quarantine_until[:15] = 100                  # only 5 eligible
+    cohort = reg.sample_cohort(0)
+    assert len(cohort) == 5                          # size is data
+    assert (cohort >= 15).all()
+    reg.quarantine_until[:] = 100
+    assert len(reg.sample_cohort(0)) == 0            # empty round, no error
+
+
+def test_cohort_digest_and_binding():
+    ids = np.array([5, 2, 9])
+    assert cohort_digest(ids) == cohort_digest(ids[::-1])
+    assert cohort_digest(ids) != cohort_digest(np.array([5, 2, 8]))
+    reg = ClientRegistry(PopulationConfig(clients=40, cohort=10, lanes=4),
+                         num_shards=4, seed=0)
+    assert reg.waves == 3
+    b = reg.bind(0, np.arange(10), np.array([7, 3, 9, 1, 5]))
+    assert b.lane_ids.shape == (3, 4) and b.valid.shape == (3, 4)
+    flat = b.lane_ids.reshape(-1)
+    assert flat[:5].tolist() == [1, 3, 5, 7, 9]      # survivors, sorted
+    assert b.valid.reshape(-1)[:5].tolist() == [1.0] * 5
+    assert b.valid.reshape(-1)[5:].tolist() == [0.0] * 7
+    assert set(flat[5:]) <= {1, 3, 5, 7, 9}          # wraparound padding
+    row = b.ledger_row(40)
+    assert row["kind"] == "cohort" and row["worker"] == -1
+    assert "waves_3" in row["action"] and "of_40" in row["action"]
+
+
+def test_validate_population_config():
+    with pytest.raises(ValueError, match="cohort"):
+        validate_population_config(PopulationConfig(clients=4, cohort=8))
+    with pytest.raises(ValueError, match="clients"):
+        validate_population_config(PopulationConfig(clients=0))
+    with pytest.raises(ValueError, match="lanes"):
+        validate_population_config(PopulationConfig(lanes=0))
+
+
+def test_population_churn_ledger_rows():
+    """Churn rows are population-keyed: per-CLIENT leave/rejoin plus
+    per-SHARD adoptions from the map ``plan_matrix_for`` actually
+    applies — never the worker-level ``adopters_for`` fabrication
+    (which assumes worker i owns shard i)."""
+    pop = PopulationConfig(clients=6, cohort=2)
+    reg = ClientRegistry(pop, num_shards=3, seed=0,
+                         faults=FaultConfig(churn=0.5))
+    # Synthetic round: shard 1's clients (ids 1, 4) both away.
+    away = np.array([False, True, False, False, True, False])
+    rows = reg.churn_ledger_rows(0, away)
+    assert {r["action"] for r in rows if r["worker"] >= 0} == {"left"}
+    assert {r["worker"] for r in rows if r["action"] == "left"} == {1, 4}
+    adopt = [r for r in rows if r["worker"] == -1]
+    assert adopt == [{"round": 0, "worker": -1, "kind": "churn",
+                      "action": "shard_1_adopted_by_2"}]
+    # A healthy fleet (clients away but every shard still covered)
+    # ledgers NO adoption rows.
+    away1 = np.array([False, True, False, False, False, False])
+    rows1 = reg.churn_ledger_rows(0, away1)
+    assert not [r for r in rows1 if "adopted" in r["action"]]
+    # End to end: a churned population run's ledger never carries the
+    # worker-level 'shard_adopted_by' fabrication (client id in the
+    # adopter field), only shard-level rows.
+    cfg = _fed_cfg(clients=50, cohort=8, lanes=8,
+                   faults=FaultConfig(churn=0.2, churn_span=2))
+    tr = _train(cfg, 3)
+    for r in tr.history.faults:
+        if r["kind"] == "churn" and "adopted" in r["action"]:
+            assert r["worker"] == -1 and r["action"].startswith("shard_")
+
+
+def test_registry_state_roundtrip():
+    pop = PopulationConfig(clients=30, cohort=6)
+    a = ClientRegistry(pop, num_shards=6, seed=1)
+    a.record_participation(3, np.array([4, 7, 9]))
+    a.screen_streak[4] = 2
+    a.quarantine_until[7] = 11
+    b = ClientRegistry(pop, num_shards=6, seed=1)
+    b.load_state(a.state_dict())
+    assert np.array_equal(a.participation, b.participation)
+    assert np.array_equal(a.last_sampled, b.last_sampled)
+    assert np.array_equal(a.screen_streak, b.screen_streak)
+    assert np.array_equal(a.quarantine_until, b.quarantine_until)
+    # Mismatched geometry is rejected loudly.
+    c = ClientRegistry(PopulationConfig(clients=30, cohort=8),
+                       num_shards=6, seed=1)
+    with pytest.raises(ValueError, match="cohort"):
+        c.load_state(a.state_dict())
+
+
+def test_batch_plan_rows_keyed_by_client_id():
+    m = np.arange(6 * 12, dtype=np.int64).reshape(6, 12)
+    full = make_batch_plan(m, batch_size=4, local_ep=1, seed=5, round_idx=2)
+    # Client ids == row ids -> bit-identical to the full plan's rows.
+    sub = make_batch_plan(m, batch_size=4, local_ep=1, seed=5, round_idx=2,
+                          workers=np.array([1, 4]), rows=np.array([1, 4]))
+    assert np.array_equal(sub.idx, full.idx[[1, 4]])
+    # Two clients sharing one shard draw DISTINCT client-keyed streams
+    # over the same rows.
+    shared = make_batch_plan(m, batch_size=4, local_ep=1, seed=5,
+                             round_idx=2, workers=np.array([10, 11]),
+                             rows=np.array([2, 2]))
+    assert sorted(shared.idx[0].ravel()) == sorted(shared.idx[1].ravel())
+    assert not np.array_equal(shared.idx[0], shared.idx[1])
+    with pytest.raises(ValueError, match="rows= requires workers="):
+        make_batch_plan(m, batch_size=4, rows=np.array([0]))
+
+
+# ---------------------------------------------------------------------
+# Federated engine: parity, determinism, quarantine, resume
+# ---------------------------------------------------------------------
+
+def test_cohort_vs_flat_parity():
+    """A full-population cohort (64 clients == 64 shards) on 8 lanes ×
+    8 waves reproduces the 64-lane flat engine's aggregate to
+    f32-allclose — hierarchical aggregation changes summation order,
+    never the math (the acceptance pin)."""
+    from dopt.engine.federated import FederatedTrainer
+
+    base = dict(
+        name="parity", seed=11,
+        data=DataConfig(dataset="synthetic", num_users=64, iid=True,
+                        synthetic_train_size=320, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", faithful=False),
+        # momentum 0: the flat engine's per-worker momentum buffer then
+        # carries nothing round to round, matching the population
+        # clients' statelessness.
+        optim=OptimizerConfig(lr=0.05, momentum=0.0),
+        federated=FederatedConfig(algorithm="fedavg", frac=1.0, rounds=2,
+                                  local_ep=1, local_bs=8),
+    )
+    # eval_train=False: the 64-lane per-worker train eval is the flat
+    # engine's costliest compile and irrelevant to the aggregate pin.
+    flat = FederatedTrainer(ExperimentConfig(**base), eval_train=False)
+    hf = flat.run(rounds=2)
+    pop = FederatedTrainer(ExperimentConfig(
+        **base, population=PopulationConfig(clients=64, cohort=64,
+                                            lanes=8)), eval_train=False)
+    hp = pop.run(rounds=2)
+    assert pop._registry.waves == 8
+    for a, b in zip(jax.tree.leaves(jax.device_get(flat.theta)),
+                    jax.tree.leaves(jax.device_get(pop.theta))):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    for rf, rp in zip(hf.rows, hp.rows):
+        assert rf["test_acc"] == pytest.approx(rp["test_acc"], abs=1e-6)
+
+
+@pytest.fixture(scope="module")
+def pop_pair():
+    """Two independently trained population runs of one config — shared
+    by the determinism / ledger / JSON-round-trip pins (tier-1 budget:
+    one compile pair instead of one per test)."""
+    cfg = _fed_cfg(clients=50, cohort=20, lanes=8)
+    return _train(cfg, 3), _train(cfg, 3)
+
+
+def test_population_run_deterministic(pop_pair):
+    a, b = pop_pair
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.theta)),
+                    jax.tree.leaves(jax.device_get(b.theta))):
+        assert np.array_equal(x, y)                  # bit-identical
+    assert a.history.faults == b.history.faults
+    assert np.array_equal(a._registry.participation,
+                          b._registry.participation)
+
+
+def test_cohort_ledger_rows_and_counts(pop_pair):
+    tr = pop_pair[0]
+    cohort_rows = [r for r in tr.history.faults if r["kind"] == "cohort"]
+    assert len(cohort_rows) == 3
+    for t, r in enumerate(cohort_rows):
+        assert r["round"] == t and r["worker"] == -1
+        assert "sampled_20_of_50" in r["action"]
+        assert "waves_3" in r["action"]              # ceil(20/8)
+    assert tr._registry.participation.sum() == 60
+    assert {"cohort": 20, "population": 50}.items() <= \
+        tr.history.rows[0].items()
+
+
+def test_quarantine_persists_across_cohorts():
+    """Adversaries are CLIENT ids: corrupt_max pins clients 0..1 as
+    persistent nan-liars, the screen catches them in whichever cohort
+    samples them, and the quarantine sentence follows the client —
+    while sentenced it is never sampled, and it is readmitted after."""
+    cfg = _fed_cfg(
+        clients=12, cohort=8, lanes=8, num_users=8, rounds=0,
+        faults=FaultConfig(corrupt=1.0, corrupt_max=2, corrupt_mode="nan"),
+        robust=RobustConfig(quarantine_after=1, quarantine_rounds=3))
+    from dopt.engine.federated import FederatedTrainer
+
+    tr = FederatedTrainer(cfg)
+    reg = tr._registry
+    for t in range(8):
+        quarantined_before = set(np.nonzero(reg.quarantine_until > t)[0])
+        tr.run(rounds=1)
+        for c in quarantined_before:                 # never sampled while
+            assert reg.last_sampled[c] != t          # serving a sentence
+    ledger = tr.history.faults
+    sentenced = {r["worker"] for r in ledger
+                 if r["kind"] == "quarantine"
+                 and r["action"].startswith("quarantined_until")}
+    assert sentenced and sentenced <= {0, 1}         # only the pinned liars
+    screened = {r["worker"] for r in ledger
+                if r["action"] == "screened_nonfinite"}
+    assert screened == sentenced
+    assert any(r["kind"] == "quarantine" and r["action"] == "readmitted"
+               for r in ledger)                      # sentences expire
+    # The nan lies never reached theta.
+    assert all(np.isfinite(x).all()
+               for x in jax.tree.leaves(jax.device_get(tr.theta)))
+
+
+def test_kill_and_resume_bit_identity(tmp_path):
+    from dopt.engine.federated import FederatedTrainer
+
+    cfg = _fed_cfg(clients=50, cohort=20, lanes=8,
+                   robust=RobustConfig(quarantine_after=2,
+                                       quarantine_rounds=3))
+    cont = _train(cfg, 3)
+    killed = FederatedTrainer(cfg)
+    killed.run(rounds=2)
+    killed.save(tmp_path / "ckpt")
+    resumed = FederatedTrainer(cfg)
+    resumed.restore(tmp_path / "ckpt")
+    assert resumed.round == 2
+    resumed.run(rounds=1)
+    for x, y in zip(jax.tree.leaves(jax.device_get(cont.theta)),
+                    jax.tree.leaves(jax.device_get(resumed.theta))):
+        assert np.array_equal(x, y)
+    assert cont.history.rows == resumed.history.rows
+    assert cont.history.faults == resumed.history.faults
+    assert np.array_equal(cont._registry.participation,
+                          resumed._registry.participation)
+    assert np.array_equal(cont._registry.last_sampled,
+                          resumed._registry.last_sampled)
+
+
+def test_restore_rejects_laneengine_checkpoint(tmp_path):
+    from dopt.engine.federated import FederatedTrainer
+
+    plain = _fed_cfg(clients=50, cohort=20, lanes=8).replace(population=None)
+    tr = FederatedTrainer(plain)
+    tr.save(tmp_path / "ckpt")     # round 0 — no compile, state suffices
+    pop = FederatedTrainer(_fed_cfg(clients=50, cohort=20, lanes=8))
+    with pytest.raises(ValueError, match="population_registry"):
+        pop.restore(tmp_path / "ckpt")
+
+
+def test_cohort_ledger_json_roundtrip(pop_pair, tmp_path):
+    tr = pop_pair[0]
+    path = tmp_path / "faults.json"
+    tr.history.faults_to_json(path)
+    back = History.faults_from_json(path)
+    assert back == tr.history.faults                 # row-for-row
+    assert any(r["kind"] == "cohort" for r in back)
+
+
+# ---------------------------------------------------------------------
+# Eligibility / rejection matrix
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("section, field, value, match", [
+    ("federated", "algorithm", "scaffold", "stateless-client"),
+    ("data", "local_holdout", 0.1, "holdout"),
+    ("federated", "compact", True, "compact"),
+    ("federated", "staleness_max", 2, "staleness"),
+    ("federated", "comm_dtype", "bfloat16", "comm_dtype"),
+    ("federated", "update_sharding", "scatter", "scatter"),
+    ("robust", "aggregator", "median", "aggregator"),
+])
+def test_population_rejections(section, field, value, match):
+    import dataclasses
+
+    from dopt.engine.federated import FederatedTrainer
+
+    cfg = _fed_cfg(clients=50, cohort=20, lanes=8)
+    sub = getattr(cfg, section) or RobustConfig()
+    cfg = cfg.replace(**{section: dataclasses.replace(sub,
+                                                      **{field: value})})
+    with pytest.raises(ValueError, match=match):
+        FederatedTrainer(cfg)
+
+
+def test_population_rejects_stale_corrupt():
+    from dopt.engine.federated import FederatedTrainer
+
+    cfg = _fed_cfg(clients=50, cohort=20, lanes=8,
+                   faults=FaultConfig(corrupt=0.5, corrupt_mode="stale"))
+    with pytest.raises(ValueError, match="stateless"):
+        FederatedTrainer(cfg)
+
+
+# ---------------------------------------------------------------------
+# Gossip engine: cohort→lane data binding
+# ---------------------------------------------------------------------
+
+def _gossip_cfg(**pop_kw):
+    return ExperimentConfig(
+        name="test-gpop", seed=5,
+        data=DataConfig(dataset="synthetic", num_users=4, iid=True,
+                        synthetic_train_size=256, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=3, local_ep=1,
+                            local_bs=32),
+        population=PopulationConfig(**pop_kw) if pop_kw else None,
+    )
+
+
+def test_gossip_population_binding_blocked_parity():
+    from dopt.engine.gossip import GossipTrainer
+
+    cfg = _gossip_cfg(clients=24, cohort=4)
+    a = GossipTrainer(cfg)
+    a.run(rounds=2, block=1)
+    b = GossipTrainer(cfg)
+    b.run(rounds=2, block=2)
+    rows_a = [r for r in a.history.faults if r["kind"] == "cohort"]
+    rows_b = [r for r in b.history.faults if r["kind"] == "cohort"]
+    assert len(rows_a) == 2 and rows_a == rows_b     # identical binding
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        assert np.array_equal(x, y)                  # bit-identical
+    assert a._registry.participation.sum() == 8
+
+
+def test_gossip_population_rejections():
+    import dataclasses
+
+    from dopt.engine.gossip import GossipTrainer
+
+    with pytest.raises(ValueError, match="cohort == data.num_users"):
+        GossipTrainer(_gossip_cfg(clients=24, cohort=8))
+    cfg = _gossip_cfg(clients=24, cohort=4)
+    with pytest.raises(ValueError, match="client-keyed faults"):
+        GossipTrainer(dataclasses.replace(
+            cfg, faults=FaultConfig(crash=0.1)))
+
+
+# ---------------------------------------------------------------------
+# Presets / CLI wiring
+# ---------------------------------------------------------------------
+
+def test_xclients_preset():
+    from dopt.presets import get_preset
+
+    cfg = get_preset("baseline3-xclients")
+    assert cfg.population is not None
+    assert cfg.population.clients == 1000
+    assert cfg.population.cohort == 64
+    assert cfg.federated is not None                 # still baseline3
+    assert cfg.data.num_users == 16
+
+
+def test_cli_population_flags():
+    from dopt.run import main
+
+    # --cohort without --clients (and no population preset) is rejected.
+    with pytest.raises(SystemExit, match="--clients"):
+        main(["--preset", "baseline3", "--cohort", "32"])
+    # Invalid combination is caught by the shared validator.
+    with pytest.raises(SystemExit, match="cohort"):
+        main(["--preset", "baseline3", "--clients", "10",
+              "--cohort", "64"])
+
+
+# ---------------------------------------------------------------------
+# Heavy sweep (slow)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_population_10k_sweep():
+    """10k-client registry end to end: 256-client cohorts on 16 lanes
+    (16 waves), two rounds — the client-scale regime the bench
+    headline measures."""
+    cfg = _fed_cfg(clients=10_000, cohort=256, lanes=16, num_users=16,
+                   train=640, local_bs=8)
+    tr = _train(cfg, 2)
+    reg = tr._registry
+    assert reg.waves == 16
+    assert reg.participation.sum() == 512
+    assert (reg.participation <= 2).all()            # without replacement
+    rows = [r for r in tr.history.faults if r["kind"] == "cohort"]
+    assert len(rows) == 2
+    assert all("sampled_256_of_10000" in r["action"] for r in rows)
+    assert all(np.isfinite(x).all()
+               for x in jax.tree.leaves(jax.device_get(tr.theta)))
