@@ -166,7 +166,7 @@ def _chaos_config(*, train_size: int, test_size: int):
 
 
 def _measure_chaos(train_size: int, test_size: int, rounds: int,
-                   repeats: int) -> dict:
+                   repeats: int, telemetry=None) -> dict:
     """Chaos-cocktail throughput, both execution paths: ``blocked``
     (all measured rounds in one fused lax.scan dispatch — the path this
     PR opened to degraded modes) and ``per_round`` (one jit dispatch +
@@ -174,12 +174,16 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
     The ratio is the headline: fused blocks must make chaos runs
     dispatch-free, and the traces are pinned bit-identical across the
     two paths by tests/test_fused_chaos.py, so the speedup is free."""
+    # Telemetry rides BOTH legs (each as its own stream segment):
+    # emission happens inside the timed window, so telemetering only
+    # one leg would skew the blocked-vs-per-round speedup ratio with
+    # --metrics-out — the ratio must compare like with like.
     blocked = _measure(_chaos_config(train_size=train_size,
                                      test_size=test_size),
-                       rounds, rounds, repeats)
+                       rounds, rounds, repeats, telemetry=telemetry)
     per_round = _measure(_chaos_config(train_size=train_size,
                                        test_size=test_size),
-                         rounds, 1, repeats)
+                         rounds, 1, repeats, telemetry=telemetry)
     return {
         "gossip_rounds_per_sec_chaos": round(blocked["rounds_per_sec"], 4),
         "chaos_spread_pct": round(blocked["spread_pct"], 2),
@@ -227,7 +231,7 @@ def _population_config(*, clients: int, cohort: int, train_size: int,
 def _measure_population(*, clients: int, cohort: int, train_size: int,
                         test_size: int, rounds: int, repeats: int,
                         local_ep: int | None = None,
-                        model: str | None = None) -> dict:
+                        model: str | None = None, telemetry=None) -> dict:
     """Client-scale throughput: rounds/sec of the population wave loop
     and the headline ``clients_per_sec`` = cohort · rounds/sec (how many
     client visits the trainer serves per second).  The federated engine
@@ -243,6 +247,10 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
                              train_size=train_size, test_size=test_size,
                              local_ep=local_ep, model=model)
     trainer = FederatedTrainer(cfg, eval_train=False)
+    if telemetry is not None:
+        from dopt.obs import attach
+
+        attach(trainer, telemetry, fresh=True)
     trainer.run(rounds=1)   # warmup: compiles the wave-scan round
     rps = []
     total = 0.0
@@ -256,6 +264,12 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
     med, spread, _ = _trimmed_stats(rps)
     reg = trainer._registry
     last = trainer.history.rows[-1]
+    if telemetry is not None:
+        # The clients/sec headline flows through the same emitter the
+        # engines use, next to the population run's round events.
+        telemetry.emit("gauge", round=max(trainer.round - 1, 0),
+                       name=f"clients_per_sec_{clients}",
+                       value=med * reg.cohort_size)
     return {
         "metric": "clients_per_sec_baseline3_xclients",
         "value": round(med * reg.cohort_size, 2),
@@ -292,7 +306,7 @@ def _trimmed_stats(values):
 
 def _measure(cfg, rounds: int, block: int, repeats: int = 5,
              device_blocks: int = 0, max_spread: float = 0.0,
-             max_retries: int = 2):
+             max_retries: int = 2, telemetry=None):
     """Warm up (compile), then time ``repeats`` independent blocks of
     ``rounds`` rounds each and reduce via ``_trimmed_stats`` — the
     tunneled chip shows ±8-27% wall-clock variance on identical code
@@ -324,6 +338,13 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
     total_dispatch = rounds * (repeats * (1 + max_retries)
                                + device_blocks + 2)
     trainer = GossipTrainer(cfg, eval_every=10 * total_dispatch + 97)
+    if telemetry is not None:
+        # Round/fault/gauge events + host spans for every measured
+        # block flow through the shared emitter (dopt.obs); `fresh`
+        # starts a new stream segment for this leg.
+        from dopt.obs import attach
+
+        attach(trainer, telemetry, fresh=True)
     # Warmup: compile the fused block step for every block size the
     # measured loop will dispatch (the remainder block retraces).
     trainer.run(rounds=block, block=block)
@@ -387,16 +408,26 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
 
             dev_us, phase_us = [], {k: 0.0 for k in PHASES}
             for _ in range(device_blocks):
-                stats = device_stats_of(one_block)
+                stats = device_stats_of(one_block, telemetry=telemetry)
+                if stats.get("warning"):
+                    # Graceful profiler degrade (dopt.utils.profiling):
+                    # the block still TRAINED (counted above); drop the
+                    # device basis rather than report NaN medians.
+                    print(f"# device-time basis degraded: "
+                          f"{stats['warning']}", file=sys.stderr)
+                    dev_us = []
+                    break
                 dev_us.append(stats["device_self_time_us"])
                 ph = stats.get("device_phases", {})
                 for k in PHASES:
                     phase_us[k] += float(ph.get(f"{k}_us", 0.0))
-            dev_ms = statistics.median(dev_us) / 1e3 / rounds
-            out["device_ms_per_round"] = dev_ms
-            out["device_rounds_per_sec"] = 1e3 / dev_ms
-            out["device_spread_pct"] = (100.0 * (max(dev_us) - min(dev_us))
-                                        / statistics.median(dev_us))
+            if dev_us:
+                dev_ms = statistics.median(dev_us) / 1e3 / rounds
+                out["device_ms_per_round"] = dev_ms
+                out["device_rounds_per_sec"] = 1e3 / dev_ms
+                out["device_spread_pct"] = (100.0
+                                            * (max(dev_us) - min(dev_us))
+                                            / statistics.median(dev_us))
             tot_us = sum(phase_us.values())
             if tot_us > 0:
                 # Conv / mixing-comm / update split of device time over
@@ -404,6 +435,11 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
                 # attribution, dopt.utils.profiling.classify_phase).
                 out["phase_fractions"] = {
                     k: round(v / tot_us, 4) for k, v in phase_us.items()}
+                if telemetry is not None:
+                    telemetry.emit(
+                        "phase", round=max(trainer.round - 1, 0),
+                        fractions=out["phase_fractions"],
+                        device_ms_per_round=out.get("device_ms_per_round"))
         except Exception as e:  # pragma: no cover - environment-dependent
             # The device-time basis needs the profiler + xprof stack;
             # its absence (or a tunnel hiccup) must not take down the
@@ -456,6 +492,16 @@ def main() -> None:
     ap.add_argument("--device-blocks", type=int, default=3,
                     help="profiler-traced blocks for the device-time-basis "
                          "rounds/sec (tunnel-immune; 0 disables)")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="stream structured telemetry (dopt.obs JSONL) "
+                         "here: the measured legs' per-round events plus "
+                         "'phase' (device-time fractions), "
+                         "'gauge' (clients_per_sec) and a final 'bench' "
+                         "event carrying the headline JSON line; "
+                         "validate with 'python -m dopt.obs.check PATH'")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome-trace JSON of the measured "
+                         "blocks' host spans here (dopt.obs span tracer)")
     ap.add_argument("--idiomatic", action="store_true",
                     help="benchmark the idiomatic model head (post-conv "
                          "ReLUs, logit head + softmax-CE — faithful=False) "
@@ -472,16 +518,40 @@ def main() -> None:
 
         enable_latency_hiding_scheduler()
 
+    tele = None
+    if args.metrics_out or args.trace_out:
+        from dopt.obs import Telemetry
+
+        tele = (Telemetry.to_jsonl(args.metrics_out)
+                if args.metrics_out else Telemetry())
+
+    def _finish_telemetry(result: dict | None = None) -> None:
+        if tele is None:
+            return
+        if result is not None:
+            from dopt.obs.events import sanitize_metrics
+
+            tele.emit("bench", metrics=sanitize_metrics(result))
+        tele.close()
+        if args.trace_out:
+            tele.write_trace(args.trace_out)
+            print(f"# wrote host span trace to {args.trace_out}",
+                  file=sys.stderr)
+        if args.metrics_out:
+            print(f"# wrote telemetry stream to {args.metrics_out}",
+                  file=sys.stderr)
+
     if args.quick:
         # CI-artifact mode: tiny data, two measured rounds per path —
         # enough to exercise both execution paths end to end and emit
         # the tracked JSON shape; the VALUE is only meaningful from a
         # real accelerator run (the full bench measures it properly).
         chaos = _measure_chaos(1_536, 512, rounds=args.rounds or 2,
-                               repeats=2)
-        print(json.dumps({"metric": "gossip_rounds_per_sec_chaos",
-                          "value": chaos["gossip_rounds_per_sec_chaos"],
-                          "unit": "rounds/sec", "quick": True, **chaos}))
+                               repeats=2, telemetry=tele)
+        quick_line = {"metric": "gossip_rounds_per_sec_chaos",
+                      "value": chaos["gossip_rounds_per_sec_chaos"],
+                      "unit": "rounds/sec", "quick": True, **chaos}
+        print(json.dumps(quick_line))
         if not args.skip_clients:
             # Client-scale quick line: the 1k-client baseline3 cohort
             # loop end to end (sampling → 4-wave scan → hierarchical
@@ -490,8 +560,12 @@ def main() -> None:
             popm = _measure_population(clients=1_000, cohort=64,
                                        train_size=1_536, test_size=512,
                                        rounds=args.rounds or 2,
-                                       repeats=2, local_ep=1, model="mlp")
+                                       repeats=2, local_ep=1, model="mlp",
+                                       telemetry=tele)
             print(json.dumps({**popm, "quick": True}))
+            quick_line.update({f"clients_{k}": v for k, v in popm.items()
+                               if isinstance(v, (int, float))})
+        _finish_telemetry(quick_line)
         return
 
     train_size = 6_000 if args.smoke else 60_000
@@ -512,7 +586,7 @@ def main() -> None:
                 faithful_model=faithful_model,
                 update_sharding=args.update_sharding),
         rounds, block, repeats, device_blocks=device_blocks,
-        max_spread=max_spread)
+        max_spread=max_spread, telemetry=tele)
     kind, peak = _device_peak_flops()
     fast_sps = fast["samples_per_sec"]
     result = {
@@ -559,7 +633,8 @@ def main() -> None:
         # Second headline: the degraded-network cocktail at blocked
         # (fused-scan) speed, with the pre-change per-round path timed
         # alongside so the dispatch-overhead win stays measured.
-        chaos = _measure_chaos(train_size, test_size, rounds, repeats)
+        chaos = _measure_chaos(train_size, test_size, rounds, repeats,
+                               telemetry=tele)
         result.update(chaos)
         print(f"# chaos cocktail: blocked "
               f"{chaos['gossip_rounds_per_sec_chaos']:.4f} r/s vs "
@@ -576,7 +651,7 @@ def main() -> None:
                 clients=n_clients, cohort=cohort, train_size=train_size,
                 test_size=test_size,
                 rounds=max(rounds // 4, 2) if not args.smoke else 2,
-                repeats=repeats)
+                repeats=repeats, telemetry=tele)
             result[f"clients_per_sec_{n_clients // 1000}k"] = popm["value"]
             print(f"# clients/sec @ population={n_clients} "
                   f"(cohort {cohort}, {popm['waves']} waves): "
@@ -608,6 +683,7 @@ def main() -> None:
           f"{fast['spread_pct']:.1f}%; acc={fast['avg_test_acc']:.4f}, "
           f"{fast_sps:,.0f} samples/s)", file=sys.stderr)
     print(json.dumps(result))
+    _finish_telemetry(result)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,8 @@ class FederatedTrainer:
     the local epochs for sampled workers only.
     """
 
+    engine_kind = "federated"
+
     def __init__(self, cfg: ExperimentConfig, *, eval_train: bool = True):
         if cfg.federated is None:
             raise ValueError("cfg.federated must be set for FederatedTrainer")
@@ -94,6 +96,12 @@ class FederatedTrainer:
         # the reference (only sampled clients run update_weights).
         self.client_history = History(cfg.name + "-clients")
         self.timers = PhaseTimers()
+        # Telemetry (dopt.obs): None (default) = the exact pre-telemetry
+        # host loop; set via dopt.obs.attach.  Every emission site below
+        # is python-gated on it and lives on the HOST side of the
+        # post-fetch boundary, so the compiled device programs are
+        # independent of it either way.
+        self.telemetry = None
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -1679,10 +1687,12 @@ class FederatedTrainer:
                 cohort=n,
                 population=reg.clients,
             )
+            self._round_telemetry(t, rows)
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:
                 self.save(checkpoint_path)
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     def _run_blocked(self, frac: float, rounds: int, block: int,
@@ -1805,6 +1815,7 @@ class FederatedTrainer:
                           if compact
                           else {k_: v[sels[j]] for k_, v in em.items()})
                     self._append_client_rows(t, em, sels[j])
+                self._round_telemetry(t, frows[j])
                 self.round += 1
             done += k
             if next_ckpt is not None and self.round >= next_ckpt:
@@ -1812,6 +1823,7 @@ class FederatedTrainer:
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     def _run_blocked_chaos(self, frac: float, rounds: int, block: int,
@@ -1915,6 +1927,7 @@ class FederatedTrainer:
                 if self._holdout:
                     em = {k_: v[sel] for k_, v in em.items()}
                     self._append_client_rows(t, em, sel)
+                self._round_telemetry(t, frows)
                 self.round += 1
             # The host replay and the device carry apply the same rule
             # to the same flags; drift is a bug, surfaced loudly.
@@ -1939,6 +1952,7 @@ class FederatedTrainer:
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     def run(self, frac: float | None = None, rounds: int | None = None,
@@ -2076,10 +2090,12 @@ class FederatedTrainer:
                 em = ({k_: v[:len(sel)] for k_, v in em.items()} if use_c
                       else {k_: v[sel] for k_, v in em.items()})
                 self._append_client_rows(t, em, sel)
+            self._round_telemetry(t, frows)
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:
                 self.save(checkpoint_path)
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     def _unpack_host_metrics(self, vec: np.ndarray, lanes: int):
@@ -2123,11 +2139,65 @@ class FederatedTrainer:
                     val_acc=float(va[j, e]), val_loss=float(vl[j, e]),
                 )
 
+    # -- telemetry (dopt.obs) ------------------------------------------
+    def _round_telemetry(self, t: int, frows: list) -> None:
+        """Emit round t's telemetry bundle: the fault-ledger rows as
+        typed events, the history row just appended as the ``round``
+        event, and the host-mirror state (quarantine streaks, the
+        staleness-buffer schedule, the population registry) as
+        ``gauge`` events.  Everything here derives from the same
+        post-fetch host-replay data on every execution path — called
+        at the identical point of the per-round, blocked, chaos-blocked
+        and population loops — so the streams are bit-identical across
+        paths; ``telemetry=None`` skips it entirely."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        gauges = {
+            "quarantine_active": float((self._quarantine_until > t).sum()),
+            "screen_streak_max": float(self._screen_streak.max()),
+        }
+        if self._has_stale:
+            gauges["stale_pending"] = float((self._stale_weight > 0).sum())
+            gauges["stale_weight_total"] = float(self._stale_weight.sum())
+        if self._registry is not None:
+            reg = self._registry
+            gauges["population_quarantined"] = float(
+                (reg.quarantine_until > t).sum())
+            gauges["population_sampled_total"] = float(
+                (reg.participation > 0).sum())
+        tele.emit_round_bundle(t, engine=self.engine_kind,
+                               metrics=self.history.rows[-1],
+                               faults=frows, gauges=gauges)
+
+    def _run_summary_telemetry(self) -> None:
+        """End-of-``run()`` consensus-distance gauge: mean over workers
+        of ‖pᵢ − theta‖₂ from the final device state — one fetch per
+        run() call, so per-round and blocked execution of the same call
+        pattern emit the identical event.  Population mode skips it
+        (clients are stateless; the stacked lane params are not client
+        state)."""
+        tele = self.telemetry
+        if tele is None or self.round == 0 or self._registry is not None:
+            return
+        import math
+
+        from dopt.obs import consensus_distance
+
+        cd = consensus_distance(self.params, self.theta)
+        if math.isfinite(cd):  # a diverged fleet has no distance to report
+            tele.emit("gauge", round=self.round - 1,
+                      name="consensus_distance", value=cd)
+
     def save(self, path) -> None:
         """Checkpoint (theta, stacked params, momentum, duals, round,
         history, sampling-RNG state).  Persisting the RNG state makes a
         resumed run draw the SAME client samples a continuous run would
         — without it, round t after resume replays round 0's sample."""
+        with self.timers.phase("checkpoint"):
+            self._save(path)
+
+    def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint
 
         arrays = {"theta": self.theta, "params": self.params}

@@ -109,6 +109,8 @@ class GossipTrainer:
                       communication-efficient decentralized training.
     """
 
+    engine_kind = "gossip"
+
     def __init__(self, cfg: ExperimentConfig, *, eval_every: int = 1):
         if cfg.gossip is None:
             raise ValueError("cfg.gossip must be set for GossipTrainer")
@@ -143,6 +145,11 @@ class GossipTrainer:
         # engine.
         self.client_history = History(cfg.name + "-clients")
         self.timers = PhaseTimers()
+        # Telemetry (dopt.obs): None (default) = the exact pre-telemetry
+        # host loop; set via dopt.obs.attach.  All emission sites are
+        # python-gated host code after the post-fetch boundary, so the
+        # compiled device programs are independent of it either way.
+        self.telemetry = None
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -1257,6 +1264,7 @@ class GossipTrainer:
                 self.history.append(**row)
                 if self._holdout:
                     self._append_client_rows(t, em)
+                self._round_telemetry(t, rows_j if fused_quar else frows[j])
                 self.round += 1
             if fused_quar:
                 # The host replay and the device carry apply the same
@@ -1277,6 +1285,7 @@ class GossipTrainer:
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     # ------------------------------------------------------------------
@@ -1316,6 +1325,50 @@ class GossipTrainer:
                     train_loss=float(tl[i, e]), train_acc=float(ta[i, e]),
                     val_acc=float(va[i, e]), val_loss=float(vl[i, e]),
                 )
+
+    # -- telemetry (dopt.obs) ------------------------------------------
+    def _round_telemetry(self, t: int, frows: list) -> None:
+        """Emit round t's telemetry bundle: the fault-ledger rows as
+        typed events, the history row just appended as the ``round``
+        event, and the host-mirror state (quarantine streaks, the
+        population registry) as ``gauge`` events.  Derived only from
+        post-fetch host-replay data at the identical point of the
+        per-round and blocked loops, so the streams are bit-identical
+        across execution paths; ``telemetry=None`` skips it."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        gauges = {
+            "quarantine_active": float((self._quarantine_until > t).sum()),
+            "screen_streak_max": float(self._screen_streak.max()),
+        }
+        if self._registry is not None:
+            reg = self._registry
+            gauges["population_quarantined"] = float(
+                (reg.quarantine_until > t).sum())
+            gauges["population_sampled_total"] = float(
+                (reg.participation > 0).sum())
+        tele.emit_round_bundle(t, engine=self.engine_kind,
+                               metrics=self.history.rows[-1],
+                               faults=frows, gauges=gauges)
+
+    def _run_summary_telemetry(self) -> None:
+        """End-of-``run()`` consensus-distance gauge: mean over workers
+        of ‖xᵢ − x̄‖₂ on the de-biased estimates (push-sum runs measure
+        the ratio estimates — the quantity that actually converges).
+        One fetch per run() call; identical across execution paths for
+        an identical call pattern."""
+        tele = self.telemetry
+        if tele is None or self.round == 0:
+            return
+        import math
+
+        from dopt.obs import consensus_distance
+
+        cd = consensus_distance(self._debiased_params())
+        if math.isfinite(cd):  # a diverged fleet has no distance to report
+            tele.emit("gauge", round=self.round - 1,
+                      name="consensus_distance", value=cd)
 
     def _matrix_for_round(self, t: int) -> np.ndarray:
         g = self.cfg.gossip
@@ -1602,11 +1655,13 @@ class GossipTrainer:
             self.history.append(**row)
             if self._holdout:
                 self._append_client_rows(t, em)
+            self._round_telemetry(t, frows)
             self.round += 1
             if (checkpoint_every and
                     self.round % checkpoint_every == 0):
                 self.save(checkpoint_path)
         self.total_time = time.time() - t0
+        self._run_summary_telemetry()
         return self.history
 
     # ------------------------------------------------------------------
@@ -1614,6 +1669,10 @@ class GossipTrainer:
         """Checkpoint full training state: params, momentum, round,
         history, AND host RNG state (the matching RNG is stateful — a
         resumed 'gossip' run must not replay round-0 matchings)."""
+        with self.timers.phase("checkpoint"):
+            self._save(path)
+
+    def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint
 
         arrays = {"params": self.params, "momentum": self.momentum}

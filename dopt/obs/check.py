@@ -1,0 +1,48 @@
+"""Telemetry stream checker: ``python -m dopt.obs.check metrics.jsonl``.
+
+Validates every event against the versioned schema (dopt.obs.events)
+and enforces the continuity invariant — within each ``run`` segment the
+round sequence is gapless and duplicate-free — then prints a one-line
+summary per file.  Exit code 1 on the first violation, so CI can gate
+on the artifact it just produced.  Stdlib-only (no jax import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any
+
+from dopt.obs.events import check_stream
+from dopt.obs.sinks import JsonlSink
+
+
+def check_file(path: str) -> dict[str, Any]:
+    """Validate one JSONL stream file; returns the check_stream summary
+    (raises ValueError on schema or continuity violations)."""
+    events = JsonlSink.read(path)
+    if not events:
+        raise ValueError(f"{path}: empty telemetry stream")
+    return check_stream(events)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", metavar="METRICS_JSONL")
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            s = check_file(path)
+        except (OSError, ValueError) as e:
+            print(f"{path}: FAIL {e}", file=sys.stderr)
+            rc = 1
+            continue
+        kinds = " ".join(f"{k}={v}" for k, v in sorted(s["kinds"].items()))
+        print(f"{path}: ok — {s['events']} events, {s['rounds']} rounds, "
+              f"{s['segments']} segment(s) [{kinds}]")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

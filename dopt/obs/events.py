@@ -1,0 +1,210 @@
+"""Versioned telemetry event schema (the stream ``dopt serve`` will speak).
+
+Every telemetry record is one JSON object with a ``v`` schema version,
+a ``kind``, and a wall-clock ``ts``.  The kinds:
+
+``run``      stream segment header — emitted once per attached run (and
+             again on resume, with ``round`` = the resume watermark),
+             so one physical JSONL file can carry several logical
+             segments (a resumed run, bench's multiple legs) and the
+             checker knows where each round sequence restarts.
+``round``    one per training round: ``metrics`` carries the engine's
+             history row (loss/acc/local_loss/...); optional
+             ``consensus_distance`` / ``phase`` / ``collective_bytes``
+             fields when the producer has them (bench attaches phase
+             fractions; the engines emit consensus distance as an
+             end-of-run gauge instead — see dopt.obs docstring).
+``gauge``    a named scalar lifted from host-mirror state at the same
+             post-fetch boundary the ledger replay uses: quarantine
+             streaks, staleness-buffer occupancy, population-registry
+             counters, end-of-run consensus distance.
+``fault``    one per fault-ledger row, typed: ``fault`` is the ledger
+             kind (dopt.faults.KINDS), ``action`` the action string.
+``phase``    device-time phase attribution (conv/comm/update/other
+             fractions) from a profiler-traced window (bench.py).
+``bench``    a benchmark result line (bench.py's JSON dict) re-emitted
+             through the same stream.
+``warning``  a degraded-but-continuing condition (e.g. the xplane
+             profiler reduction failed mid-bench).
+
+Deterministic kinds (``DETERMINISTIC_KINDS``) are derived exclusively
+from post-fetch host-replay data, so per-round, blocked and
+killed-and-resumed execution emit bit-identical sequences of them —
+``canonical()`` (drop ``ts``, filter kinds) is the comparison form the
+chaos soak and tests/test_obs.py pin.
+
+This module is stdlib-only (no jax/numpy) so ``python -m dopt.obs.check``
+stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Iterable
+
+SCHEMA_VERSION = 1
+
+KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning")
+
+# Kinds whose content is a pure function of the round's host-replay
+# data: streams filtered to these (ts dropped) are bit-identical across
+# per-round / blocked / resumed execution of the same config.
+DETERMINISTIC_KINDS = ("round", "fault", "gauge")
+
+
+def make_event(kind: str, **fields: Any) -> dict[str, Any]:
+    """Build one schema-stamped event; top-level ``None`` fields are
+    dropped (absent beats null for optional fields)."""
+    ev: dict[str, Any] = {"v": SCHEMA_VERSION, "kind": kind,
+                          "ts": round(time.time(), 6)}
+    ev.update({k: v for k, v in fields.items() if v is not None})
+    return ev
+
+
+def sanitize_metrics(metrics) -> dict[str, Any]:
+    """Non-finite floats become null: NaN is not JSON (jq and every
+    strict parser reject it), and a divergence under Byzantine stress
+    is a legitimate thing for the stream to carry — as an explicit
+    absent value, not a parse error."""
+    return {k: (None if isinstance(v, float) and not math.isfinite(v)
+                else v) for k, v in dict(metrics).items()}
+
+
+def _fail(msg: str, ev: Any) -> None:
+    raise ValueError(f"{msg}: {ev!r}")
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _req_int(ev: dict, key: str, *, lo: int = 0) -> int:
+    v = ev.get(key)
+    if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+        _fail(f"event needs int {key!r} >= {lo}", ev)
+    return v
+
+
+def _req_str(ev: dict, key: str) -> str:
+    v = ev.get(key)
+    if not isinstance(v, str) or not v:
+        _fail(f"event needs non-empty str {key!r}", ev)
+    return v
+
+
+def validate_event(ev: Any) -> dict[str, Any]:
+    """Validate one event against the schema; returns it, raises
+    ``ValueError`` with the offending object otherwise.  Unknown extra
+    keys are allowed (forward compatibility); known keys are typed."""
+    if not isinstance(ev, dict):
+        _fail("event is not an object", ev)
+    if ev.get("v") != SCHEMA_VERSION:
+        _fail(f"unknown schema version (want v={SCHEMA_VERSION})", ev)
+    kind = ev.get("kind")
+    if kind not in KINDS:
+        _fail(f"unknown event kind (want one of {KINDS})", ev)
+    ts = ev.get("ts")
+    if not _is_num(ts) or ts < 0:
+        _fail("event needs numeric ts >= 0", ev)
+    if kind == "run":
+        _req_str(ev, "engine")
+        _req_str(ev, "name")
+        _req_int(ev, "round")
+        if "workers" in ev:
+            _req_int(ev, "workers", lo=1)
+    elif kind == "round":
+        _req_int(ev, "round")
+        _req_str(ev, "engine")
+        m = ev.get("metrics")
+        if not isinstance(m, dict):
+            _fail("round event needs a metrics object", ev)
+        for k, v in m.items():
+            if not isinstance(k, str):
+                _fail("round metrics keys must be strings", ev)
+            if v is None or isinstance(v, (str, bool)):
+                continue
+            if not _is_num(v) or not math.isfinite(v):
+                _fail(f"round metric {k!r} must be finite", ev)
+        if "consensus_distance" in ev and not _is_num(
+                ev["consensus_distance"]):
+            _fail("consensus_distance must be numeric", ev)
+        if "collective_bytes" in ev:
+            _req_int(ev, "collective_bytes")
+    elif kind == "gauge":
+        _req_int(ev, "round")
+        _req_str(ev, "name")
+        v = ev.get("value")
+        if not _is_num(v) or not math.isfinite(v):
+            _fail("gauge event needs a finite numeric value", ev)
+    elif kind == "fault":
+        _req_int(ev, "round")
+        # worker -1 = fleet-level row (the population registry's
+        # ``cohort`` audit rows are not about one worker).
+        _req_int(ev, "worker", lo=-1)
+        _req_str(ev, "fault")
+        _req_str(ev, "action")
+    elif kind == "phase":
+        fr = ev.get("fractions")
+        if not isinstance(fr, dict) or not fr:
+            _fail("phase event needs a fractions object", ev)
+        for k, v in fr.items():
+            if not isinstance(k, str) or not _is_num(v) or not (
+                    0.0 <= v <= 1.0):
+                _fail(f"phase fraction {k!r} must be in [0, 1]", ev)
+        if "round" in ev:
+            _req_int(ev, "round")
+    elif kind == "bench":
+        m = ev.get("metrics")
+        if not isinstance(m, dict):
+            _fail("bench event needs a metrics object", ev)
+        for k, v in m.items():
+            if not isinstance(k, str):
+                _fail("bench metrics keys must be strings", ev)
+            if _is_num(v) and not math.isfinite(v):
+                _fail(f"bench metric {k!r} must be finite", ev)
+    elif kind == "warning":
+        _req_str(ev, "message")
+    return ev
+
+
+def check_stream(events: Iterable[Any]) -> dict[str, Any]:
+    """Validate a whole stream and its continuity invariant: within
+    each segment (opened by a ``run`` event, whose ``round`` declares
+    the segment's watermark start), the ``round``-event sequence must
+    be gapless and duplicate-free.  Returns a summary dict; raises
+    ``ValueError`` on the first violation."""
+    kinds: dict[str, int] = {}
+    expected: int | None = None
+    rounds = segments = total = 0
+    for ev in events:
+        validate_event(ev)
+        total += 1
+        kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+        if ev["kind"] == "run":
+            expected = int(ev["round"])
+            segments += 1
+        elif ev["kind"] == "round":
+            t = int(ev["round"])
+            if expected is None:
+                # Headerless stream: the first round event anchors it.
+                expected = t
+                segments += 1
+            if t != expected:
+                _fail(f"round sequence broken: expected round {expected}",
+                      ev)
+            expected = t + 1
+            rounds += 1
+    return {"events": total, "rounds": rounds, "segments": segments,
+            "kinds": kinds}
+
+
+def canonical(events: Iterable[dict],
+              kinds: tuple[str, ...] = DETERMINISTIC_KINDS,
+              drop: tuple[str, ...] = ("ts",)) -> list[dict[str, Any]]:
+    """The comparison form for stream-equality invariants: events
+    filtered to the deterministic kinds with wall-clock fields
+    dropped.  ``canonical(a) == canonical(b)`` is the blocked-vs-
+    per-round (and resume) contract."""
+    return [{k: v for k, v in ev.items() if k not in drop}
+            for ev in events if ev.get("kind") in kinds]

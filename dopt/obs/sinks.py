@@ -1,0 +1,243 @@
+"""Telemetry sinks: where the event stream lands.
+
+Three consumers, one ``emit(event)`` contract:
+
+* ``JsonlSink`` — one JSON object per line, flushed per event so a
+  SIGKILLed run leaves a complete prefix (at worst one truncated final
+  line, which ``read`` tolerates at EOF).  Opened in append mode for
+  resumed runs; ``scan_watermark`` recovers the monotonic round
+  watermark from an existing file.
+* ``MemorySink`` — bounded in-memory ring for tests and live
+  inspection.
+* ``PrometheusSink`` — maintains the LATEST value of every numeric
+  round metric and gauge plus per-kind fault counters, and renders a
+  Prometheus text-exposition snapshot (the scrape surface the future
+  ``dopt serve`` will mount).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import deque
+from pathlib import Path
+from typing import Any, Iterator
+
+
+def _jsonable(v: Any):
+    """json.dumps fallback: unwrap numpy/jax scalars without importing
+    either (telemetry must not drag device deps into the host path)."""
+    item = getattr(v, "item", None)
+    if callable(item):
+        return item()
+    raise TypeError(f"event field {v!r} is not JSON-serialisable")
+
+
+class Sink:
+    def emit(self, event: dict[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def emit_many(self, events: list[dict[str, Any]]) -> None:
+        """Batch emission; file sinks override it to make one round's
+        bundle a single flushed write (crash leaves whole bundles, not
+        a torn one — the resume watermark depends on it)."""
+        for ev in events:
+            self.emit(ev)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink(Sink):
+    """Append-structured JSONL file sink (line-flushed, crash-safe
+    prefix)."""
+
+    def __init__(self, path: str | Path, *, append: bool = False):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if append:
+            JsonlSink.repair_tail(self.path)
+        self._f = open(self.path, "a" if append else "w")
+
+    def emit(self, event: dict[str, Any]) -> None:
+        self._f.write(json.dumps(event, separators=(",", ":"),
+                                 default=_jsonable) + "\n")
+        self._f.flush()
+
+    def emit_many(self, events: list[dict[str, Any]]) -> None:
+        """One round's bundle as ONE write + flush.  For bundles within
+        the stdio buffer this reaches the OS as one write, so a kill
+        leaves the whole bundle or none of it; a bundle large enough to
+        straddle buffer flushes CAN tear, which is why ``repair_tail``
+        drops unsealed fault/gauge events before a resume appends."""
+        self._f.write("".join(
+            json.dumps(ev, separators=(",", ":"), default=_jsonable) + "\n"
+            for ev in events))
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    @staticmethod
+    def read(path: str | Path) -> list[dict[str, Any]]:
+        """Load a JSONL stream.  A truncated FINAL line (the one a kill
+        can leave) is dropped; garbage anywhere else raises."""
+        lines = Path(path).read_text().splitlines()
+        events: list[dict[str, Any]] = []
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                if i == len(lines) - 1:
+                    break
+                raise ValueError(
+                    f"{path}: line {i + 1} is not JSON: {line[:80]!r}")
+        return events
+
+    @staticmethod
+    def repair_tail(path: str | Path) -> None:
+        """Repair what a SIGKILL mid-write can leave, BEFORE a resumed
+        segment appends.  An unterminated final line is healed (a
+        newline appended) when it parses — JSON self-delimits, so the
+        event is complete and only the terminator was torn — and
+        dropped when it does not (once appended events follow it the
+        garbage would sit MID-file, where ``read`` rightly raises).
+        Then any trailing complete ``fault``/``gauge`` events whose
+        round was never sealed by a ``round`` event are dropped: the
+        resumed run re-emits that round's whole bundle, so keeping the
+        orphans would silently double-count faults.  Every decision is
+        made against the repaired bytes, so the watermark
+        ``scan_watermark`` recovers (before OR after the repair) always
+        agrees with what survives on disk."""
+        path = Path(path)
+        if not path.exists():
+            return
+        orig = raw = path.read_bytes()
+        if raw and not raw.endswith(b"\n"):
+            nl = raw.rfind(b"\n") + 1
+            try:
+                json.loads(raw[nl:].strip())
+            except ValueError:
+                raw = raw[:nl]
+            else:
+                raw = raw + b"\n"
+        sealed = -1
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # mid-file garbage: left for read() to report
+            if ev.get("kind") == "round" and isinstance(ev.get("round"), int):
+                sealed = max(sealed, ev["round"])
+        keep = len(raw)
+        while keep > 0:
+            prev = raw.rfind(b"\n", 0, keep - 1) + 1
+            line = raw[prev:keep].strip()
+            if line:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    break
+                if not (ev.get("kind") in ("fault", "gauge")
+                        and isinstance(ev.get("round"), int)
+                        and ev["round"] > sealed):
+                    break
+            keep = prev
+        if raw[:keep] != orig:
+            from dopt.utils.metrics import atomic_write_text
+
+            atomic_write_text(path, raw[:keep].decode("utf-8"))
+
+    @staticmethod
+    def scan_watermark(path: str | Path) -> int | None:
+        """Highest round already streamed to ``path`` (round events
+        only), or None when the file is absent/empty — the resume
+        watermark source."""
+        path = Path(path)
+        if not path.exists():
+            return None
+        best: int | None = None
+        for ev in JsonlSink.read(path):
+            if ev.get("kind") == "round" and isinstance(ev.get("round"), int):
+                best = ev["round"] if best is None else max(best, ev["round"])
+        return best
+
+
+class MemorySink(Sink):
+    """Bounded in-memory ring (capacity=None keeps everything)."""
+
+    def __init__(self, capacity: int | None = None):
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+
+    def emit(self, event: dict[str, Any]) -> None:
+        self._ring.append(event)
+
+    @property
+    def events(self) -> list[dict[str, Any]]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
+        return iter(self.events)
+
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(name: str) -> str:
+    return "dopt_" + _METRIC_NAME_RE.sub("_", name)
+
+
+class PrometheusSink(Sink):
+    """Latest-value snapshot in Prometheus text-exposition format."""
+
+    def __init__(self, path: str | Path | None = None):
+        self.path = Path(path) if path is not None else None
+        self._gauges: dict[str, float] = {}
+        self._faults: dict[str, int] = {}
+
+    def emit(self, event: dict[str, Any]) -> None:
+        kind = event.get("kind")
+        if kind == "round":
+            self._gauges["dopt_round"] = float(event["round"])
+            for k, v in event.get("metrics", {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self._gauges[_metric_name(k)] = float(v)
+        elif kind == "gauge":
+            self._gauges[_metric_name(event["name"])] = float(event["value"])
+        elif kind == "fault":
+            f = str(event["fault"])
+            self._faults[f] = self._faults.get(f, 0) + 1
+
+    def render(self) -> str:
+        lines = []
+        for name in sorted(self._gauges):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {self._gauges[name]!r}")
+        if self._faults:
+            lines.append("# TYPE dopt_faults_total counter")
+            for kind in sorted(self._faults):
+                lines.append(
+                    f'dopt_faults_total{{kind="{kind}"}} {self._faults[kind]}')
+        return "\n".join(lines) + "\n"
+
+    def write(self, path: str | Path | None = None) -> Path:
+        from dopt.utils.metrics import atomic_write_text
+
+        target = Path(path) if path is not None else self.path
+        if target is None:
+            raise ValueError("PrometheusSink needs a path to write to")
+        return atomic_write_text(target, self.render())
+
+    def close(self) -> None:
+        if self.path is not None:
+            self.write()

@@ -1,0 +1,91 @@
+"""Host-side span tracing with Chrome-trace (Perfetto) export.
+
+``SpanTracer.span("block")`` is a nestable context manager that records
+(name, start, duration, depth) against the tracer's epoch.  The engines
+do not call it directly: ``dopt.utils.profiling.PhaseTimers`` grew a
+``tracer`` hook, so attaching telemetry to a trainer
+(``dopt.obs.attach``) instruments every existing ``timers.phase(...)``
+/ ``timers.measure(...)`` site — host batch planning, the fused block
+dispatch, checkpoint writes — with zero run-loop changes, and callers
+can open extra spans (``telemetry.span("eval")``) around anything else.
+
+``write_chrome`` emits the ``{"traceEvents": [...]}`` JSON the Chrome
+tracing UI / Perfetto / TensorBoard's trace viewer load directly
+(complete ``"ph": "X"`` events on one track; nesting is by time
+containment).  This is the HOST-side companion to the XLA trace from
+``dopt.utils.profiling.trace`` — spans show where the round loop's wall
+clock went, the XLA trace shows what the device did inside it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Iterator
+
+# Spans accrue a few records per round for as long as telemetry is
+# attached — a million-round metrics-only run must not leak host memory
+# into a trace nobody asked for, so the record list is a bounded ring
+# (the Chrome export carries the most recent spans; per-name totals
+# accumulate exactly regardless of eviction).
+DEFAULT_SPAN_CAPACITY = 100_000
+
+
+class SpanTracer:
+    """Accumulates nested host spans; cheap enough to leave attached."""
+
+    def __init__(self, clock=time.perf_counter,
+                 capacity: int | None = DEFAULT_SPAN_CAPACITY):
+        self._clock = clock
+        self._t0 = clock()
+        self._depth = 0
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._totals: dict[str, float] = {}
+
+    @property
+    def spans(self) -> list[dict[str, Any]]:
+        return list(self._ring)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = self._clock()
+        self._depth += 1
+        depth = self._depth - 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            t1 = self._clock()
+            name = str(name)
+            self._ring.append({
+                "name": name,
+                "ts_us": (t0 - self._t0) * 1e6,
+                "dur_us": (t1 - t0) * 1e6,
+                "depth": depth,
+            })
+            self._totals[name] = (self._totals.get(name, 0.0)
+                                  + (t1 - t0))
+
+    def totals(self) -> dict[str, float]:
+        """Per-name wall-clock seconds (PhaseTimers-shaped summary);
+        exact even after ring eviction."""
+        return dict(self._totals)
+
+    def to_chrome(self) -> list[dict[str, Any]]:
+        """Chrome-trace complete events, sorted by start time."""
+        return [
+            {"name": s["name"], "cat": "dopt", "ph": "X", "pid": 0,
+             "tid": 0, "ts": round(s["ts_us"], 3),
+             "dur": round(s["dur_us"], 3)}
+            for s in sorted(self.spans, key=lambda s: s["ts_us"])
+        ]
+
+    def write_chrome(self, path: str | Path) -> Path:
+        from dopt.utils.metrics import atomic_write_text
+
+        payload = {"traceEvents": self.to_chrome(),
+                   "displayTimeUnit": "ms"}
+        return atomic_write_text(path, json.dumps(payload))

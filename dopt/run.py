@@ -164,6 +164,21 @@ def main(argv: list[str] | None = None) -> int:
                          "seed); draws are stateless per (seed, round)")
     ap.add_argument("--faults-json", default=None, metavar="PATH",
                     help="write the run's fault ledger here as JSON")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="stream structured telemetry (dopt.obs) to this "
+                         "JSONL file: one versioned event per line — "
+                         "per-round 'round' events (the history row), "
+                         "typed 'fault' events (the ledger), and 'gauge' "
+                         "events (quarantine/staleness/population state, "
+                         "end-of-run consensus distance).  With --resume "
+                         "the stream APPENDS and continues from its round "
+                         "watermark (no duplicated or missing rounds); "
+                         "validate with 'python -m dopt.obs.check PATH'")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome-trace/Perfetto JSON of the host "
+                         "spans (batch planning, fused block dispatches, "
+                         "checkpoint writes) here — the host-side "
+                         "companion to the XLA trace from --trace")
     ap.add_argument("--timers", action="store_true",
                     help="print phase-timer report")
     ap.add_argument("--trace", default=None, metavar="DIR",
@@ -270,6 +285,21 @@ def main(argv: list[str] | None = None) -> int:
         trainer.restore(args.resume)
         print(f"resumed at round {trainer.round}", file=sys.stderr)
 
+    tele = None
+    if args.metrics_out or args.trace_out:
+        if cfg.seqlm is not None or cfg.backend == "torch":
+            # Same contract as --faults: only the federated/gossip jax
+            # engines carry the emission sites — reject instead of
+            # writing an empty stream the user believes is telemetry.
+            raise SystemExit("--metrics-out/--trace-out are supported by "
+                             "the federated/gossip jax engines only")
+        from dopt.obs import Telemetry, attach
+
+        tele = (Telemetry.to_jsonl(args.metrics_out,
+                                   resume=bool(args.resume))
+                if args.metrics_out else Telemetry())
+        attach(trainer, tele)
+
     rounds = args.rounds
     if rounds is None:
         if cfg.seqlm is not None:
@@ -295,6 +325,15 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote XLA trace to {args.trace}", file=sys.stderr)
     else:
         trainer.run(rounds=rounds, **run_kw)
+    if tele is not None:
+        tele.close()
+        if args.metrics_out:
+            print(f"wrote telemetry stream to {args.metrics_out}",
+                  file=sys.stderr)
+        if args.trace_out:
+            tele.write_trace(args.trace_out)
+            print(f"wrote host span trace to {args.trace_out}",
+                  file=sys.stderr)
     for row in trainer.history.rows[-min(rounds, len(trainer.history)):]:
         print(json.dumps(row))
     print(f"total_time_s={trainer.total_time:.2f}", file=sys.stderr)

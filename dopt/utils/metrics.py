@@ -131,10 +131,45 @@ class History:
         with open(path, newline="") as f:
             reader = csv.DictReader(f)
             for row in reader:
+                # Blank cells are ABSENT keys, not empty strings: the
+                # CSV layout unions heterogeneous row schemas (non-eval
+                # rounds carry fewer keys than eval rounds) and fills
+                # the gaps with "", so the round trip must drop them to
+                # recover the original row shapes.
                 h.rows.append({
-                    k: _maybe_num(v) for k, v in row.items() if k not in ("", None)
+                    k: _maybe_num(v) for k, v in row.items()
+                    if k not in ("", None) and v != ""
                 })
         return h
+
+    def merge_resumed(self, rows, *, key: str = "round") -> int:
+        """Fold per-round rows from a RESUMED run into this history,
+        enforcing the same monotonic round watermark the telemetry
+        resume path uses (dopt.obs): rows at rounds this history
+        already holds are dropped (the continuous prefix wins — no
+        duplicates), and the first genuinely new row must CONTINUE the
+        sequence (a gap raises — a missing round means the resume lost
+        data).  Returns the number of rows appended."""
+        last = -1
+        for r in self.rows:
+            if key in r and isinstance(r[key], int):
+                last = max(last, r[key])
+        appended = 0
+        for r in rows:
+            t = r.get(key)
+            if not isinstance(t, int):
+                raise ValueError(
+                    f"merge_resumed: row without an int {key!r}: {r!r}")
+            if t <= last:
+                continue
+            if t != last + 1:
+                raise ValueError(
+                    f"merge_resumed: round gap {last} -> {t} (the resumed "
+                    "stream is missing rounds)")
+            self.rows.append(dict(r))
+            last = t
+            appended += 1
+        return appended
 
 
 def time_to_target(history: "History", *, target: float,

@@ -28,28 +28,43 @@ import jax
 
 
 class PhaseTimers:
-    """Accumulates wall-clock per named phase."""
+    """Accumulates wall-clock per named phase.
 
-    def __init__(self) -> None:
+    ``tracer`` is the telemetry hook (``dopt.obs.SpanTracer`` — or
+    anything with a ``span(name)`` context manager): when set, every
+    ``phase``/``measure`` additionally records a nested host span, so
+    attaching telemetry to a trainer instruments all its existing
+    timer sites (host batch planning, the fused block dispatch,
+    checkpoint writes) with zero run-loop changes.  None (default)
+    keeps the exact pre-telemetry accounting."""
+
+    def __init__(self, tracer=None) -> None:
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.tracer = tracer
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
         """Host wall-clock for the block (dispatch-only for jit calls —
         use ``measure`` to include device time)."""
+        span = (self.tracer.span(name) if self.tracer is not None
+                else contextlib.nullcontext())
         t0 = time.perf_counter()
         try:
-            yield
+            with span:
+                yield
         finally:
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
 
     def measure(self, name: str, fn, *args, **kwargs):
         """Run fn, block on its result, attribute the time to ``name``."""
+        span = (self.tracer.span(name) if self.tracer is not None
+                else contextlib.nullcontext())
         t0 = time.perf_counter()
-        out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
+        with span:
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
         self.totals[name] += time.perf_counter() - t0
         self.counts[name] += 1
         return out
@@ -207,23 +222,69 @@ def xplane_op_stats(trace_dir: str) -> dict[str, Any]:
     }
 
 
-def device_stats_of(fn, *, trace_prefix: str = "dopt-devtime-") -> dict:
+def device_stats_of(fn, *, trace_prefix: str = "dopt-devtime-",
+                    telemetry=None) -> dict:
     """Run ``fn()`` under a profiler trace and return the full
     ``xplane_op_stats`` reduction (device self time + the
-    conv/comm/update phase split)."""
+    conv/comm/update phase split).
+
+    Degrades instead of raising mid-bench: if the profiler cannot
+    start/stop or the xplane/tensorboard reduction fails (missing
+    xprof stack, parse error), the returned dict carries NaN device
+    time, empty breakdowns and a ``warning`` field describing the
+    failure — and a ``warning`` telemetry event when ``telemetry``
+    (``dopt.obs.Telemetry``) is supplied.  ``fn()``'s own exceptions
+    still propagate (a failing workload is a real error).  The temp
+    trace directory is removed on every path."""
+    import shutil
     import tempfile
 
-    with tempfile.TemporaryDirectory(prefix=trace_prefix) as td:
-        with trace(td):
+    td = tempfile.mkdtemp(prefix=trace_prefix)
+    warning = None
+    try:
+        started = True
+        try:
+            jax.profiler.start_trace(td)
+        except Exception as e:
+            started = False
+            warning = f"profiler start failed: {e!r}"
+        try:
             fn()
-        return xplane_op_stats(td)
+        finally:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    warning = warning or f"profiler stop failed: {e!r}"
+        stats = None
+        if warning is None:
+            try:
+                stats = xplane_op_stats(td)
+            except Exception as e:
+                warning = f"xplane reduction failed: {e!r}"
+        if stats is None:
+            stats = {"device_self_time_us": float("nan"),
+                     "host_self_time_us": float("nan"),
+                     "device_categories": [], "device_phases": {},
+                     "top_device_ops": []}
+        if warning is not None:
+            stats["warning"] = warning
+            if telemetry is not None:
+                telemetry.emit("warning", message=warning,
+                               source="device_stats_of")
+        return stats
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
 
 
-def device_time_of(fn, *, trace_prefix: str = "dopt-devtime-") -> float:
+def device_time_of(fn, *, trace_prefix: str = "dopt-devtime-",
+                   telemetry=None) -> float:
     """Run ``fn()`` under a profiler trace and return the device self
-    time in microseconds — the tunnel-immune basis for rounds/sec."""
-    return device_stats_of(fn, trace_prefix=trace_prefix)[
-        "device_self_time_us"]
+    time in microseconds — the tunnel-immune basis for rounds/sec.
+    NaN (plus a warning event, see ``device_stats_of``) when the
+    profiler stack degrades."""
+    return device_stats_of(fn, trace_prefix=trace_prefix,
+                           telemetry=telemetry)["device_self_time_us"]
 
 
 # ---------------------------------------------------------------------

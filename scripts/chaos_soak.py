@@ -135,16 +135,31 @@ def check_convergence(history, tol: float) -> tuple[float, float]:
 
 
 def soak_one(engine: str, seed: int, rounds: int, tol: float,
-             ckpt_dir: str, kill: bool) -> None:
+             ckpt_dir: str, kill: bool, metrics_sink=None) -> None:
+    from dopt.obs import (JsonlSink, MemorySink, Telemetry, attach,
+                          canonical, check_stream)
+
     w = _DATA.num_users
     print(f"[{engine}] cocktail seed={seed}: continuous run ...")
     cont = build_trainer(engine, seed, rounds)
+    mem = MemorySink()
+    sinks = [mem] + ([metrics_sink] if metrics_sink is not None else [])
+    attach(cont, Telemetry(sinks), fresh=True)
     hc = cont.run(rounds=rounds)
     first, last = check_convergence(hc, tol)
     n_rows = check_ledger(hc, rounds, w)
     print(f"[{engine}] loss {first:.4f} -> {last:.4f}, "
           f"{n_rows} ledger rows, kinds "
           f"{sorted(set(r['kind'] for r in hc.faults))}")
+
+    # Telemetry-stream invariants (dopt.obs): every event is
+    # schema-valid and the round sequence is gapless and
+    # duplicate-free; the typed fault events mirror the ledger 1:1.
+    summary = check_stream(mem.events)
+    assert summary["rounds"] == rounds, summary
+    assert summary["kinds"].get("fault", 0) == n_rows, summary
+    print(f"[{engine}] telemetry stream ok: {summary['events']} events "
+          f"({summary['kinds']})")
 
     # Determinism: the identical config replays the identical storm.
     rerun = build_trainer(engine, seed, rounds)
@@ -160,40 +175,72 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     # path the throughput work fused; bit-identity is what makes the
     # speedup free.
     blk = build_trainer(engine, seed, rounds)
+    mem_b = MemorySink()
+    attach(blk, Telemetry([mem_b]), fresh=True)
     hb = blk.run(rounds=rounds, block=max(rounds // 2, 2))
     assert hb.rows == hc.rows, \
         f"blocked History diverged from per-round ({engine})"
     assert hb.faults == hc.faults, \
         f"blocked fault ledger diverged from per-round ({engine})"
-    print(f"[{engine}] fused-block execution bit-identical ok")
+    assert canonical(mem_b.events) == canonical(mem.events), \
+        f"blocked telemetry stream diverged from per-round ({engine})"
+    print(f"[{engine}] fused-block execution bit-identical ok "
+          "(History + ledger + event stream)")
 
-    # Kill-and-resume bit-identity.
+    # Kill-and-resume bit-identity, including the telemetry stream's
+    # monotonic round watermark: the resumed run APPENDS to the dead
+    # run's JSONL and the merged file must carry every round exactly
+    # once.
     path = os.path.join(ckpt_dir, f"{engine}-{seed}")
+    mpath = os.path.join(ckpt_dir, f"{engine}-{seed}-metrics.jsonl")
+    # A persistent --ckpt-dir may hold a previous invocation's stream;
+    # the child opens it resume=True and a stale watermark would
+    # suppress this run's emission entirely — start from a clean file.
+    if os.path.exists(mpath):
+        os.unlink(mpath)
     kill_at = max(rounds // 2, 1)
     if kill:
-        _sigkill_child(engine, seed, rounds, kill_at, path)
+        _sigkill_child(engine, seed, rounds, kill_at, path, mpath)
     else:
         part = build_trainer(engine, seed, rounds)
+        tele_p = Telemetry.to_jsonl(mpath)
+        attach(part, tele_p)
         part.run(rounds=kill_at, checkpoint_every=1, checkpoint_path=path)
+        tele_p.close()
     res = build_trainer(engine, seed, rounds)
     res.restore(path)
     assert res.round >= 1, "no checkpoint survived the kill"
+    tele_r = Telemetry.to_jsonl(mpath, resume=True)
+    attach(res, tele_r)
     hk = res.run(rounds=rounds - res.round)
+    tele_r.close()
     assert hk.rows == hc.rows, \
         f"resumed History diverged from continuous ({engine})"
     assert hk.faults == hc.faults, \
         f"resumed fault ledger diverged from continuous ({engine})"
+    merged = JsonlSink.read(mpath)
+    check_stream(merged)
+    got = [e["round"] for e in merged if e["kind"] == "round"]
+    assert got == list(range(rounds)), \
+        f"resumed stream rounds {got} != 0..{rounds - 1} ({engine})"
+    assert (canonical(merged, kinds=("round", "fault"))
+            == canonical(mem.events, kinds=("round", "fault"))), \
+        f"resumed telemetry stream diverged from continuous ({engine})"
     print(f"[{engine}] {'SIGKILL' if kill else 'in-process kill'}"
-          f"-and-resume bit-identical ok")
+          f"-and-resume bit-identical ok (stream watermark gapless)")
 
 
 def _sigkill_child(engine: str, seed: int, rounds: int, kill_at: int,
-                   path: str) -> None:
+                   path: str, metrics_path: str | None = None) -> None:
     """Spawn this script as a child running the soak config with
     per-round auto-checkpoints, SIGKILL it once it reports ``kill_at``
-    completed rounds, and leave its latest checkpoint for the caller."""
+    completed rounds, and leave its latest checkpoint (and telemetry
+    stream prefix — the JSONL sink flushes per event, so the kill
+    leaves a complete prefix) for the caller."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", engine,
            "--seed", str(seed), "--rounds", str(rounds), "--ckpt", path]
+    if metrics_path:
+        cmd += ["--child-metrics", metrics_path]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     child = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                              env=env)
@@ -212,8 +259,15 @@ def _sigkill_child(engine: str, seed: int, rounds: int, kill_at: int,
     time.sleep(0.2)
 
 
-def child_main(engine: str, seed: int, rounds: int, path: str) -> int:
+def child_main(engine: str, seed: int, rounds: int, path: str,
+               metrics_path: str | None = None) -> int:
     trainer = build_trainer(engine, seed, rounds)
+    if metrics_path:
+        from dopt.obs import Telemetry, attach
+
+        # resume=True: a fresh file starts at watermark 0, a restarted
+        # child appends past what it already streamed.
+        attach(trainer, Telemetry.to_jsonl(metrics_path, resume=True))
     for _ in range(rounds):
         trainer.run(rounds=1, checkpoint_every=1, checkpoint_path=path)
         print(f"ROUND {trainer.round - 1}", flush=True)
@@ -234,24 +288,39 @@ def main(argv: list[str] | None = None) -> int:
                          "instead of the in-process stop")
     ap.add_argument("--ckpt-dir", default=None,
                     help="checkpoint scratch dir (default: a temp dir)")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="stream the continuous soak runs' telemetry "
+                         "(dopt.obs JSONL, one segment per engine) here "
+                         "— the CI artifact; validate with "
+                         "'python -m dopt.obs.check PATH'")
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--ckpt", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--child-metrics", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.child:
-        return child_main(args.child, args.seed, args.rounds, args.ckpt)
+        return child_main(args.child, args.seed, args.rounds, args.ckpt,
+                          args.child_metrics)
 
     import tempfile
 
     engines = (["gossip", "federated"] if args.engine == "both"
                else [args.engine])
+    metrics_sink = None
+    if args.metrics_out:
+        from dopt.obs import JsonlSink
+
+        metrics_sink = JsonlSink(args.metrics_out)
     with tempfile.TemporaryDirectory() as tmp:
         ckpt_dir = args.ckpt_dir or tmp
         for engine in engines:
             soak_one(engine, args.seed, args.rounds, args.tol, ckpt_dir,
-                     args.kill)
-    print("chaos soak passed: convergence + ledger + checkpoint "
-          "invariants hold under the full cocktail")
+                     args.kill, metrics_sink=metrics_sink)
+    if metrics_sink is not None:
+        metrics_sink.close()
+        print(f"wrote telemetry stream to {args.metrics_out}")
+    print("chaos soak passed: convergence + ledger + checkpoint + "
+          "telemetry-stream invariants hold under the full cocktail")
     return 0
 
 

@@ -78,6 +78,21 @@ def conv_flops(h, cin, cout, k, stride, batch, groups, pad="SAME"):
     return 2 * macs          # fwd FLOPs; training = 3x (fwd+bwd)
 
 
+def _device_seconds(blk) -> float:
+    """Profiler device self-time of ``blk()`` in seconds.  Roofline
+    numbers are committed artifacts, so a degraded profiler stack
+    (which ``device_stats_of`` tolerates for bench) must fail LOUDLY
+    here — NaN-derived TFLOP/s in the JSON would be worse than no run."""
+    from dopt.utils.profiling import device_stats_of
+
+    stats = device_stats_of(blk)
+    if "warning" in stats:
+        raise RuntimeError(
+            "roofline needs the profiler device-time basis but it "
+            f"degraded: {stats['warning']}")
+    return stats["device_self_time_us"] / 1e6
+
+
 def measure(fn, args, iters):
     """Per-iteration time of fwd + dK + dX (the full 3x-fwd training
     cost the table's FLOP accounting assumes), measured as ONE jitted
@@ -104,12 +119,10 @@ def measure(fn, args, iters):
     # sub-second intervals (block_until_ready returns early; a naive
     # loop measured >40 PFLOP/s on a 197 TF/s chip).  The profiler's
     # device self-time is repeatable to ~0.01% and is the basis here.
-    from dopt.utils.profiling import device_time_of
-
     def blk():
         jax.block_until_ready(run(*args))
 
-    return device_time_of(blk) / 1e6 / iters
+    return _device_seconds(blk) / iters
 
 
 def bench_layer(h, cin, cout, k, stride, *, workers=W, lane_batch=B,
@@ -186,12 +199,11 @@ def bench_update(params_total, iters, *, lr=0.01, mu=0.5):
 
     run = jax.jit(run_impl)
     jax.block_until_ready(run(p, m, g))
-    from dopt.utils.profiling import device_time_of
 
     def blk():
         jax.block_until_ready(run(p, m, g))
 
-    return device_time_of(blk) / 1e6 / iters
+    return _device_seconds(blk) / iters
 
 
 def fleet_param_count(geom) -> int:

@@ -1,0 +1,504 @@
+"""Unified telemetry (dopt.obs): schema, sinks, spans, and the stream
+invariants the subsystem owes the engines.
+
+The heavy contracts, all tier-1-lean (mlp, tiny synthetic data, few
+rounds; trainer builds are shared via module fixtures because each
+build recompiles its round programs):
+
+* schema validation of every event kind (and rejection of malformed
+  events);
+* blocked-vs-per-round event-stream equality on a chaos cocktail, both
+  engines (the streams derive from the same host-replay data at the
+  same post-fetch points, so fused execution is not a different
+  experiment);
+* kill-and-resume watermark continuity: the resumed run APPENDS to the
+  dead run's JSONL and the merged stream carries every round exactly
+  once;
+* telemetry-off bit-identity: attaching telemetry changes nothing
+  about the training trace (History rows + fault ledger) — the off
+  path is the exact pre-change loop;
+* graceful profiler degrade: a failing xplane reduction returns
+  partial stats + a warning event instead of raising mid-bench.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig)
+from dopt.obs import (JsonlSink, MemorySink, PrometheusSink, SpanTracer,
+                      Telemetry, attach, canonical, check_stream,
+                      make_event, validate_event)
+from dopt.utils.metrics import History
+
+_DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
+                   synthetic_train_size=256, synthetic_test_size=64)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
+_ROUNDS = 6
+
+
+def _fed_cfg() -> ExperimentConfig:
+    """Federated chaos cocktail routing through the fused chaos-block
+    path (staleness buffer as scan carry) with nan liars and a drop
+    deadline — the hardest emission path to keep deterministic."""
+    return ExperimentConfig(
+        name="obs-fed", seed=11, data=_DATA, model=_MODEL, optim=_OPTIM,
+        federated=FederatedConfig(algorithm="fedavg", frac=0.5,
+                                  rounds=_ROUNDS, local_ep=1, local_bs=32,
+                                  staleness_max=2, staleness_decay=0.5),
+        faults=FaultConfig(crash=0.1, straggle=0.4, straggle_frac=0.5,
+                           straggler_policy="drop", over_select=0.3,
+                           corrupt=0.2, corrupt_mode="nan",
+                           msg_delay=0.2, msg_delay_max=2))
+
+
+def _gossip_cfg() -> ExperimentConfig:
+    """Gossip link-mode cocktail (push-sum + drops/delays/churn) — the
+    mass/staleness-buffer scan-carry blocked path."""
+    return ExperimentConfig(
+        name="obs-gossip", seed=11, data=_DATA, model=_MODEL, optim=_OPTIM,
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=_ROUNDS, local_ep=1,
+                            local_bs=32, correction="push_sum"),
+        faults=FaultConfig(crash=0.1, straggle=0.2, straggle_frac=0.5,
+                           msg_drop=0.2, msg_delay=0.2, msg_delay_max=2,
+                           churn=0.05, churn_span=2))
+
+
+def _trainer(cfg: ExperimentConfig):
+    if cfg.federated is not None:
+        from dopt.engine.federated import FederatedTrainer
+
+        return FederatedTrainer(cfg)
+    from dopt.engine.gossip import GossipTrainer
+
+    return GossipTrainer(cfg)
+
+
+@pytest.fixture(scope="module")
+def fed_continuous():
+    """One telemetry-attached continuous federated run, shared by the
+    equality / resume / off-identity tests (each build recompiles)."""
+    tr = _trainer(_fed_cfg())
+    mem = MemorySink()
+    attach(tr, Telemetry([mem]), fresh=True)
+    h = tr.run(rounds=_ROUNDS)
+    return h, mem.events
+
+
+@pytest.fixture(scope="module")
+def gossip_continuous():
+    tr = _trainer(_gossip_cfg())
+    mem = MemorySink()
+    attach(tr, Telemetry([mem]), fresh=True)
+    h = tr.run(rounds=_ROUNDS)
+    return h, mem.events
+
+
+# ---------------------------------------------------------------- schema
+def test_every_event_kind_validates():
+    events = [
+        make_event("run", engine="federated", name="x", round=0, workers=8),
+        make_event("round", round=0, engine="federated",
+                   metrics={"round": 0, "test_acc": 0.5, "note": "s",
+                            "skipped": None}),
+        make_event("gauge", round=0, name="quarantine_active", value=1.0),
+        make_event("fault", round=0, worker=3, fault="crash",
+                   action="dropped_from_round"),
+        make_event("fault", round=0, worker=-1, fault="cohort",
+                   action="sampled_64_of_1000"),  # fleet-level row
+        make_event("phase", round=4, fractions={"conv": 0.5, "comm": 0.3,
+                                                "update": 0.1,
+                                                "other": 0.1}),
+        make_event("bench", metrics={"value": 2.5, "unit": "rounds/sec",
+                                     "quick": True, "na": None}),
+        make_event("warning", message="xplane reduction failed",
+                   source="device_stats_of"),
+    ]
+    for ev in events:
+        validate_event(ev)
+    s = check_stream(events)
+    assert s["events"] == len(events) and s["rounds"] == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "not-an-object",
+    {"v": 99, "kind": "round", "ts": 0.0},                 # bad version
+    {"v": 1, "kind": "nope", "ts": 0.0},                   # unknown kind
+    {"v": 1, "kind": "round", "ts": 0.0},                  # missing fields
+    {"v": 1, "kind": "round", "ts": 0.0, "round": 0, "engine": "g",
+     "metrics": {"x": float("nan")}},                      # non-finite
+    {"v": 1, "kind": "gauge", "ts": 0.0, "round": 0, "name": "",
+     "value": 1.0},                                        # empty name
+    {"v": 1, "kind": "fault", "ts": 0.0, "round": 0, "worker": -2,
+     "fault": "crash", "action": "x"},                     # worker < -1
+    {"v": 1, "kind": "phase", "ts": 0.0, "fractions": {"conv": 1.5}},
+])
+def test_malformed_events_rejected(bad):
+    with pytest.raises(ValueError):
+        validate_event(bad)
+
+
+def test_round_continuity_enforced():
+    evs = [make_event("run", engine="g", name="x", round=0),
+           make_event("round", round=0, engine="g", metrics={}),
+           make_event("round", round=2, engine="g", metrics={})]
+    with pytest.raises(ValueError, match="round sequence broken"):
+        check_stream(evs)
+    # a run header legitimately restarts the sequence (new segment)
+    evs = [make_event("run", engine="g", name="x", round=0),
+           make_event("round", round=0, engine="g", metrics={}),
+           make_event("run", engine="f", name="y", round=0),
+           make_event("round", round=0, engine="f", metrics={})]
+    assert check_stream(evs)["segments"] == 2
+
+
+# ----------------------------------------------------------------- sinks
+def test_jsonl_sink_roundtrip_watermark_and_truncation(tmp_path):
+    p = tmp_path / "m.jsonl"
+    t = Telemetry.to_jsonl(p)
+    t.emit("run", engine="g", name="x", round=0)
+    t.emit_round_bundle(0, engine="g", metrics={"a": 1.0},
+                        faults=[{"round": 0, "worker": 1, "kind": "crash",
+                                 "action": "skipped_round"}],
+                        gauges={"g1": 2.0})
+    t.emit_round_bundle(1, engine="g", metrics={"a": 0.5})
+    t.close()
+    assert JsonlSink.scan_watermark(p) == 1
+    # a kill can truncate the FINAL line; read() must drop it silently
+    with open(p, "a") as f:
+        f.write('{"v": 1, "kind": "round", "ro')
+    evs = JsonlSink.read(p)
+    assert [e["round"] for e in evs if e["kind"] == "round"] == [0, 1]
+    # resume: the watermark suppresses already-streamed rounds
+    t2 = Telemetry.to_jsonl(p, resume=True)
+    assert t2.watermark == 2
+    assert not t2.emit_round_bundle(1, engine="g", metrics={})
+    assert t2.emit_round_bundle(2, engine="g", metrics={})
+    t2.close()
+    check_stream(JsonlSink.read(p))
+
+
+def test_jsonl_repair_tail_on_resume(tmp_path):
+    """A SIGKILL mid-bundle can leave (a) a truncated final line and
+    (b) complete fault lines whose round event never landed.  Resuming
+    must repair both: (a) would otherwise sit mid-file once appended
+    events follow it, (b) would be silently double-counted when the
+    resumed run re-emits the unfinished round's bundle."""
+    p = tmp_path / "m.jsonl"
+    t = Telemetry.to_jsonl(p)
+    t.emit("run", engine="g", name="x", round=0)
+    t.emit_round_bundle(0, engine="g", metrics={"a": 1.0})
+    t.close()
+    fault1 = {"round": 1, "worker": 2, "kind": "crash",
+              "action": "skipped_round"}
+    with open(p, "a") as f:
+        # orphaned complete fault line of the unfinished round-1 bundle
+        f.write(json.dumps(make_event("fault", round=1, worker=2,
+                                      fault="crash",
+                                      action="skipped_round")) + "\n")
+        # then the torn round event itself
+        f.write('{"v": 1, "kind": "round", "ro')
+    t2 = Telemetry.to_jsonl(p, resume=True)
+    assert t2.watermark == 1
+    t2.emit_round_bundle(1, engine="g", metrics={"a": 0.5}, faults=[fault1])
+    t2.close()
+    merged = JsonlSink.read(p)      # raises if the torn line merged
+    check_stream(merged)
+    assert [e["round"] for e in merged if e["kind"] == "round"] == [0, 1]
+    assert len([e for e in merged if e["kind"] == "fault"]) == 1
+
+
+def test_jsonl_repair_heals_unterminated_final_event(tmp_path):
+    """A kill can also tear the flush between an event's closing brace
+    and its newline: the line parses (JSON self-delimits) so the round
+    is complete — repair must HEAL the terminator, not drop the line,
+    or the resume watermark (which counts the parseable line) would
+    suppress a round the repaired file no longer carries."""
+    p = tmp_path / "m.jsonl"
+    t = Telemetry.to_jsonl(p)
+    t.emit("run", engine="g", name="x", round=0)
+    t.emit_round_bundle(0, engine="g", metrics={"a": 1.0},
+                        faults=[{"round": 0, "worker": 1, "kind": "crash",
+                                 "action": "skipped_round"}],
+                        gauges={"g1": 2.0})
+    t.emit_round_bundle(1, engine="g", metrics={"a": 0.5})
+    t.close()
+    raw = p.read_bytes()
+    assert raw.endswith(b"\n")
+    p.write_bytes(raw[:-1])
+    t2 = Telemetry.to_jsonl(p, resume=True)
+    assert t2.watermark == 2            # round 1 still counts
+    t2.emit_round_bundle(2, engine="g", metrics={"a": 0.25})
+    t2.close()
+    merged = JsonlSink.read(p)
+    check_stream(merged)
+    assert [e["round"] for e in merged if e["kind"] == "round"] == [0, 1, 2]
+    assert len([e for e in merged if e["kind"] == "fault"]) == 1
+
+
+def test_memory_ring_capacity():
+    mem = MemorySink(capacity=3)
+    for i in range(10):
+        mem.emit(make_event("gauge", round=i, name="x", value=float(i)))
+    assert len(mem) == 3
+    assert [e["round"] for e in mem.events] == [7, 8, 9]
+
+
+def test_prometheus_snapshot(tmp_path):
+    prom = PrometheusSink(tmp_path / "prom.txt")
+    t = Telemetry([prom])
+    t.emit_round_bundle(0, engine="f", metrics={"test_acc": 0.25},
+                        faults=[{"round": 0, "worker": 1, "kind": "crash",
+                                 "action": "x"},
+                                {"round": 0, "worker": 2, "kind": "crash",
+                                 "action": "x"}],
+                        gauges={"stale_pending": 2.0})
+    t.emit_round_bundle(1, engine="f", metrics={"test_acc": 0.75})
+    t.close()
+    text = (tmp_path / "prom.txt").read_text()
+    assert "dopt_round 1.0" in text
+    assert "dopt_test_acc 0.75" in text        # latest value wins
+    assert "dopt_stale_pending 2.0" in text
+    assert 'dopt_faults_total{kind="crash"} 2' in text
+
+
+def test_span_tracer_nesting_and_chrome_export(tmp_path):
+    tr = SpanTracer()
+    with tr.span("block"):
+        with tr.span("eval"):
+            pass
+        with tr.span("checkpoint"):
+            pass
+    chrome = tr.to_chrome()
+    assert [e["name"] for e in chrome] == ["block", "eval", "checkpoint"]
+    outer = chrome[0]
+    for inner in chrome[1:]:
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    p = tr.write_chrome(tmp_path / "trace.json")
+    payload = json.loads(p.read_text())
+    assert len(payload["traceEvents"]) == 3
+    assert set(tr.totals()) == {"block", "eval", "checkpoint"}
+
+
+def test_check_cli(tmp_path):
+    from dopt.obs.check import main
+
+    good = tmp_path / "good.jsonl"
+    t = Telemetry.to_jsonl(good)
+    t.emit("run", engine="g", name="x", round=0)
+    t.emit_round_bundle(0, engine="g", metrics={"a": 1.0})
+    t.close()
+    assert main([str(good)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(good.read_text() + json.dumps(
+        make_event("round", round=5, engine="g", metrics={})) + "\n")
+    assert main([str(bad)]) == 1                 # round gap
+    assert main([str(tmp_path / "absent.jsonl")]) == 1
+
+
+# --------------------------------------------------------------- History
+def test_history_merge_resumed_watermark():
+    h = History("m")
+    h.append(round=0, loss=1.0)
+    h.append(round=1, loss=0.9)
+    resumed = [{"round": 0, "loss": 1.0}, {"round": 1, "loss": 0.9},
+               {"round": 2, "loss": 0.8}, {"round": 3, "loss": 0.7}]
+    assert h.merge_resumed(resumed) == 2         # duplicates dropped
+    assert [r["round"] for r in h.rows] == [0, 1, 2, 3]
+    with pytest.raises(ValueError, match="round gap"):
+        h.merge_resumed([{"round": 6, "loss": 0.1}])
+    with pytest.raises(ValueError, match="without an int"):
+        h.merge_resumed([{"loss": 0.1}])
+
+
+def test_history_heterogeneous_csv_roundtrip(tmp_path):
+    h = History("h")
+    h.append(round=0, avg_train_loss=1.0, avg_test_acc=0.5)
+    h.append(round=1, avg_train_loss=0.9)        # non-eval round
+    h.append(round=2, avg_train_loss=0.8, extra_col=7)
+    p = h.to_csv(tmp_path / "h.csv")
+    header = p.read_text().splitlines()[0]
+    assert header == ",round,avg_test_acc,avg_train_loss,extra_col"
+    back = History.from_csv(p)
+    # blanks are ABSENT keys again, not empty strings
+    assert back.rows == h.rows
+
+
+# ------------------------------------------------------------- profiling
+def test_device_stats_degrade_returns_warning(monkeypatch):
+    # The real profiler costs ~15s/capture on the 8-device CPU mesh;
+    # the degrade contract is about what happens AROUND it, so stub
+    # start/stop and fail the reduction (the realistic mid-bench mode:
+    # xprof import/parse breakage).
+    from dopt.utils import profiling
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda d: None)
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", lambda: None)
+
+    def boom(_):
+        raise RuntimeError("no xprof here")
+
+    monkeypatch.setattr(profiling, "xplane_op_stats", boom)
+    mem = MemorySink()
+    ran = []
+    stats = profiling.device_stats_of(lambda: ran.append(1),
+                                      telemetry=Telemetry([mem]))
+    assert ran == [1]                            # the workload still ran
+    assert "no xprof here" in stats["warning"]
+    assert math.isnan(stats["device_self_time_us"])
+    assert stats["device_phases"] == {}
+    warns = [e for e in mem.events if e["kind"] == "warning"]
+    assert warns and warns[0]["source"] == "device_stats_of"
+    assert math.isnan(profiling.device_time_of(lambda: None))
+
+    # profiler-start failure is its own degrade branch: no reduction is
+    # attempted, fn still runs, the workload error contract holds
+    def dead_start(_):
+        raise RuntimeError("profiler busy")
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace", dead_start)
+    stats = profiling.device_stats_of(lambda: None)
+    assert "profiler busy" in stats["warning"]
+    with pytest.raises(ZeroDivisionError):
+        profiling.device_stats_of(lambda: 1 / 0)  # fn errors propagate
+
+
+def test_phase_timers_tracer_hook():
+    from dopt.utils.profiling import PhaseTimers
+
+    tr = SpanTracer()
+    timers = PhaseTimers(tracer=tr)
+    with timers.phase("host_batch_plan"):
+        pass
+    timers.measure("round_step", lambda: 1)
+    assert timers.counts["host_batch_plan"] == 1
+    assert sorted(s["name"] for s in tr.spans) == ["host_batch_plan",
+                                                   "round_step"]
+
+
+# ------------------------------------------------- engine stream contracts
+def test_federated_stream_blocked_equality_and_off_identity(fed_continuous):
+    hc, stream = fed_continuous
+    s = check_stream(stream)
+    assert s["rounds"] == _ROUNDS
+    assert s["kinds"]["fault"] == len(hc.faults)
+    # typed fault events mirror the ledger row-for-row, in order
+    assert [(e["round"], e["worker"], e["fault"], e["action"])
+            for e in stream if e["kind"] == "fault"] == \
+        [(r["round"], r["worker"], r["kind"], r["action"])
+         for r in hc.faults]
+    # the cocktail actually exercised the gauges it claims to carry
+    names = {e["name"] for e in stream if e["kind"] == "gauge"}
+    assert {"quarantine_active", "screen_streak_max", "stale_pending",
+            "stale_weight_total", "consensus_distance"} <= names
+
+    # telemetry OFF is the exact pre-change loop: same rows, same ledger
+    plain = _trainer(_fed_cfg())
+    hp = plain.run(rounds=_ROUNDS)
+    assert hp.rows == hc.rows and hp.faults == hc.faults
+
+    # blocked execution (fused chaos scan) emits the identical stream
+    blk = _trainer(_fed_cfg())
+    mem_b = MemorySink()
+    attach(blk, Telemetry([mem_b]), fresh=True)
+    hb = blk.run(rounds=_ROUNDS, block=3)
+    assert hb.rows == hc.rows and hb.faults == hc.faults
+    assert canonical(mem_b.events) == canonical(stream)
+
+
+def test_gossip_stream_blocked_equality_and_off_identity(gossip_continuous):
+    hc, stream = gossip_continuous
+    s = check_stream(stream)
+    assert s["rounds"] == _ROUNDS
+    assert s["kinds"]["fault"] == len(hc.faults)
+    names = {e["name"] for e in stream if e["kind"] == "gauge"}
+    assert {"quarantine_active", "consensus_distance"} <= names
+
+    plain = _trainer(_gossip_cfg())
+    hp = plain.run(rounds=_ROUNDS)
+    assert hp.rows == hc.rows and hp.faults == hc.faults
+
+    blk = _trainer(_gossip_cfg())
+    mem_b = MemorySink()
+    attach(blk, Telemetry([mem_b]), fresh=True)
+    hb = blk.run(rounds=_ROUNDS, block=3)
+    assert hb.rows == hc.rows and hb.faults == hc.faults
+    assert canonical(mem_b.events) == canonical(stream)
+
+
+def test_kill_resume_stream_watermark(fed_continuous, tmp_path):
+    hc, stream = fed_continuous
+    mpath = tmp_path / "m.jsonl"
+    ck = tmp_path / "ck"
+    kill_at = _ROUNDS // 2
+    part = _trainer(_fed_cfg())
+    t1 = Telemetry.to_jsonl(mpath)
+    attach(part, t1)
+    part.run(rounds=kill_at, checkpoint_every=1, checkpoint_path=ck)
+    t1.close()
+    # the PhaseTimers tracer hook spans the existing timer sites,
+    # checkpoint writes included, with zero run-loop changes
+    span_names = {s["name"] for s in t1.tracer.spans}
+    assert {"host_batch_plan", "round_step", "checkpoint"} <= span_names
+
+    res = _trainer(_fed_cfg())
+    res.restore(ck)
+    t2 = Telemetry.to_jsonl(mpath, resume=True)
+    assert t2.watermark == kill_at
+    attach(res, t2)
+    hk = res.run(rounds=_ROUNDS - res.round)
+    t2.close()
+    assert hk.rows == hc.rows and hk.faults == hc.faults
+
+    merged = JsonlSink.read(mpath)
+    check_stream(merged)
+    # no duplicated or missing rounds across the kill
+    assert [e["round"] for e in merged
+            if e["kind"] == "round"] == list(range(_ROUNDS))
+    assert (canonical(merged, kinds=("round", "fault"))
+            == canonical(stream, kinds=("round", "fault")))
+
+    # History.merge_resumed enforces the same watermark for row merges
+    h = History("m")
+    h.rows = [dict(r) for r in hc.rows[:kill_at]]
+    assert h.merge_resumed(hk.rows) == _ROUNDS - kill_at
+    assert h.rows == hc.rows
+
+
+def test_attach_emits_segment_header(fed_continuous):
+    _, stream = fed_continuous
+    runs = [e for e in stream if e["kind"] == "run"]
+    assert len(runs) == 1
+    assert runs[0]["engine"] == "federated"
+    assert runs[0]["workers"] == _DATA.num_users
+    assert runs[0]["round"] == 0
+
+
+def test_attach_header_uses_trainer_round():
+    """Resuming a checkpointed trainer into a FRESH metrics file: the
+    segment header must declare the trainer's actual starting round,
+    not the (empty) file's watermark — the checker anchors round
+    continuity on the header."""
+    from dopt.utils.profiling import PhaseTimers
+
+    class _Tr:
+        round = 7
+        engine_kind = "federated"
+        num_workers = 4
+        timers = PhaseTimers()
+
+    mem = MemorySink()
+    tele = attach(_Tr(), Telemetry([mem]))
+    assert tele.watermark == 7
+    tele.emit_round_bundle(7, engine="federated", metrics={"a": 1.0})
+    check_stream(mem.events)
+    assert [e["round"] for e in mem.events if e["kind"] == "run"] == [7]
