@@ -96,7 +96,8 @@ def _device_peak_flops() -> tuple[str, float | None]:
 
 
 def _config(*, fast: bool, train_size: int, test_size: int,
-            faithful_model: bool = True, update_sharding: str = "off"):
+            faithful_model: bool = True, update_sharding: str = "off",
+            prefetch: str = "off"):
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
                              ModelConfig, OptimizerConfig)
 
@@ -124,11 +125,13 @@ def _config(*, fast: bool, train_size: int, test_size: int,
         gossip=GossipConfig(algorithm="dsgd", topology="circle",
                             mode="stochastic", rounds=10, local_ep=4,
                             local_bs=128,
-                            update_sharding=update_sharding),
+                            update_sharding=update_sharding,
+                            prefetch=prefetch),
     )
 
 
-def _chaos_config(*, train_size: int, test_size: int):
+def _chaos_config(*, train_size: int, test_size: int,
+                  prefetch: str = "off"):
     """The degraded-network cocktail on the headline workload:
     msg_drop (lossy links) + stragglers + Byzantine scale-lies +
     quarantine armed.  Every one of these modes used to force
@@ -157,7 +160,7 @@ def _chaos_config(*, train_size: int, test_size: int):
         optim=OptimizerConfig(lr=0.05, momentum=0.5),
         gossip=GossipConfig(algorithm="dsgd", topology="circle",
                             mode="metropolis", rounds=20, local_ep=2,
-                            local_bs=64),
+                            local_bs=64, prefetch=prefetch),
         faults=FaultConfig(msg_drop=0.15, straggle=0.25, straggle_frac=0.5,
                            corrupt=0.15, corrupt_mode="scale",
                            corrupt_scale=10.0),
@@ -166,7 +169,8 @@ def _chaos_config(*, train_size: int, test_size: int):
 
 
 def _measure_chaos(train_size: int, test_size: int, rounds: int,
-                   repeats: int, telemetry=None) -> dict:
+                   repeats: int, telemetry=None,
+                   prefetch: str = "off") -> dict:
     """Chaos-cocktail throughput, both execution paths: ``blocked``
     (all measured rounds in one fused lax.scan dispatch — the path this
     PR opened to degraded modes) and ``per_round`` (one jit dispatch +
@@ -179,13 +183,18 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
     # one leg would skew the blocked-vs-per-round speedup ratio with
     # --metrics-out — the ratio must compare like with like.
     blocked = _measure(_chaos_config(train_size=train_size,
-                                     test_size=test_size),
+                                     test_size=test_size,
+                                     prefetch=prefetch),
                        rounds, rounds, repeats, telemetry=telemetry)
     per_round = _measure(_chaos_config(train_size=train_size,
                                        test_size=test_size),
                          rounds, 1, repeats, telemetry=telemetry)
     return {
         "gossip_rounds_per_sec_chaos": round(blocked["rounds_per_sec"], 4),
+        "chaos_host_gap_pct": round(blocked["host_gap_pct"], 2),
+        "chaos_host_batch_plan_fraction": round(
+            blocked["host_batch_plan_fraction"], 4),
+        "chaos_prefetch": prefetch,
         "chaos_spread_pct": round(blocked["spread_pct"], 2),
         "chaos_avg_test_acc": round(blocked["avg_test_acc"], 4),
         "chaos_per_round_rounds_per_sec": round(
@@ -198,7 +207,7 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
 
 def _population_config(*, clients: int, cohort: int, train_size: int,
                        test_size: int, local_ep: int | None = None,
-                       model: str | None = None):
+                       model: str | None = None, prefetch: str = "off"):
     """The client-scale leg: baseline3 (FedAvg, 16 non-IID MNIST
     shards, model1) with the worker==lane equation broken — a
     ``clients``-record registry sampling a ``cohort`` each round onto
@@ -216,7 +225,7 @@ def _population_config(*, clients: int, cohort: int, train_size: int,
     data = dataclasses.replace(cfg.data, synthetic_train_size=train_size,
                                synthetic_test_size=test_size,
                                plan_impl="native")
-    fed = cfg.federated
+    fed = dataclasses.replace(cfg.federated, prefetch=prefetch)
     if local_ep is not None:
         fed = dataclasses.replace(fed, local_ep=local_ep)
     mdl = cfg.model
@@ -231,7 +240,8 @@ def _population_config(*, clients: int, cohort: int, train_size: int,
 def _measure_population(*, clients: int, cohort: int, train_size: int,
                         test_size: int, rounds: int, repeats: int,
                         local_ep: int | None = None,
-                        model: str | None = None, telemetry=None) -> dict:
+                        model: str | None = None, telemetry=None,
+                        prefetch: str = "off") -> dict:
     """Client-scale throughput: rounds/sec of the population wave loop
     and the headline ``clients_per_sec`` = cohort · rounds/sec (how many
     client visits the trainer serves per second).  The federated engine
@@ -245,7 +255,8 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
 
     cfg = _population_config(clients=clients, cohort=cohort,
                              train_size=train_size, test_size=test_size,
-                             local_ep=local_ep, model=model)
+                             local_ep=local_ep, model=model,
+                             prefetch=prefetch)
     trainer = FederatedTrainer(cfg, eval_train=False)
     if telemetry is not None:
         from dopt.obs import attach
@@ -264,12 +275,17 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
     med, spread, _ = _trimmed_stats(rps)
     reg = trainer._registry
     last = trainer.history.rows[-1]
+    plan_s = trainer.timers.totals.get("host_batch_plan", 0.0)
+    step_s = trainer.timers.totals.get("round_step", 0.0)
+    plan_frac = plan_s / (plan_s + step_s) if plan_s + step_s > 0 else 0.0
     if telemetry is not None:
         # The clients/sec headline flows through the same emitter the
         # engines use, next to the population run's round events.
         telemetry.emit("gauge", round=max(trainer.round - 1, 0),
                        name=f"clients_per_sec_{clients}",
                        value=med * reg.cohort_size)
+        telemetry.emit("gauge", round=max(trainer.round - 1, 0),
+                       name="host_batch_plan_fraction", value=plan_frac)
     return {
         "metric": "clients_per_sec_baseline3_xclients",
         "value": round(med * reg.cohort_size, 2),
@@ -283,6 +299,9 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
         "rounds_per_sec": round(med, 4),
         "spread_pct": round(spread, 2),
         "measured_seconds": round(total, 2),
+        "prefetch": prefetch,
+        "host_gap_pct": round(100.0 * plan_frac, 2),
+        "host_batch_plan_fraction": round(plan_frac, 4),
         "eval_fused": True,
         "final_test_acc": round(float(last["test_acc"]), 4),
         "total_trained_rounds": trainer.round,
@@ -290,18 +309,11 @@ def _measure_population(*, clients: int, cohort: int, train_size: int,
 
 
 def _trimmed_stats(values):
-    """Outlier-hardened reduction of per-block rounds/sec samples:
-    with >= 4 samples the min and max are DISCARDED (the tunneled chip
-    throws occasional multi-second stalls that poison a plain
-    max−min spread), then (median, spread_pct, kept) over the
-    survivors; spread_pct = (max−min)/median·100 of the kept set."""
-    import statistics
+    """Shared with scripts/bench_seqlm.py — see
+    ``dopt.utils.metrics.trimmed_stats``."""
+    from dopt.utils.metrics import trimmed_stats
 
-    vals = sorted(float(v) for v in values)
-    kept = vals[1:-1] if len(vals) >= 4 else vals
-    med = statistics.median(kept)
-    spread = 100.0 * (kept[-1] - kept[0]) / med if med > 0 else 0.0
-    return med, spread, kept
+    return trimmed_stats(values)
 
 
 def _measure(cfg, rounds: int, block: int, repeats: int = 5,
@@ -446,6 +458,27 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
             # wall-clock benchmark the driver records.
             print(f"# device-time basis unavailable: {e!r}",
                   file=sys.stderr)
+    # Host-gap accounting (ROADMAP lever 2, the prefetch PR's measured
+    # claim): how much of the wall the host pipeline costs.  Primary
+    # basis: device vs wall rounds/sec (tunnel-immune, from the traced
+    # blocks); fallback when no device basis ran (--quick, smoke, a
+    # degraded profiler): the host-timer estimate — the
+    # host_batch_plan share of the measured phases.  Always finite.
+    plan_s = trainer.timers.totals.get("host_batch_plan", 0.0)
+    step_s = trainer.timers.totals.get("round_step", 0.0)
+    plan_frac = plan_s / (plan_s + step_s) if plan_s + step_s > 0 else 0.0
+    out["host_batch_plan_fraction"] = plan_frac
+    if "device_rounds_per_sec" in out:
+        out["host_gap_pct"] = 100.0 * (
+            1.0 - out["rounds_per_sec"] / out["device_rounds_per_sec"])
+    else:
+        out["host_gap_pct"] = 100.0 * plan_frac
+    if telemetry is not None:
+        r = max(trainer.round - 1, 0)
+        telemetry.emit("gauge", round=r, name="host_gap_pct",
+                       value=float(out["host_gap_pct"]))
+        telemetry.emit("gauge", round=r, name="host_batch_plan_fraction",
+                       value=float(plan_frac))
     # Post-run accuracy reflects ALL rounds trained above (ADVICE r4):
     # the count is recorded so the accuracy column is interpretable.
     out["total_trained_rounds"] = trained
@@ -489,6 +522,16 @@ def main() -> None:
                          "XLA latency-hiding scheduler armed; the "
                          "faithful f32 leg always runs 'off' (the "
                          "oracle-parity program)")
+    ap.add_argument("--prefetch", choices=("on", "off"), default="on",
+                    help="host-pipeline prefetch (GossipConfig/"
+                         "FederatedConfig.prefetch) on the fast, chaos "
+                         "and client-scale legs: block b+1's batch "
+                         "plans are built + staged to device while "
+                         "block b runs (dopt.data.prefetch) — the "
+                         "ROADMAP lever-2 overlap; bit-identical to "
+                         "'off' by construction.  The faithful f32 leg "
+                         "always runs 'off' (the oracle-parity host "
+                         "loop)")
     ap.add_argument("--device-blocks", type=int, default=3,
                     help="profiler-traced blocks for the device-time-basis "
                          "rounds/sec (tunnel-immune; 0 disables)")
@@ -547,10 +590,19 @@ def main() -> None:
         # the tracked JSON shape; the VALUE is only meaningful from a
         # real accelerator run (the full bench measures it properly).
         chaos = _measure_chaos(1_536, 512, rounds=args.rounds or 2,
-                               repeats=2, telemetry=tele)
+                               repeats=2, telemetry=tele,
+                               prefetch=args.prefetch)
         quick_line = {"metric": "gossip_rounds_per_sec_chaos",
                       "value": chaos["gossip_rounds_per_sec_chaos"],
-                      "unit": "rounds/sec", "quick": True, **chaos}
+                      "unit": "rounds/sec", "quick": True,
+                      # The CI artifact contract: host_gap_pct present
+                      # and finite even without a device-time basis
+                      # (here: the host-timer estimate of the chaos
+                      # blocked leg).
+                      "host_gap_pct": chaos["chaos_host_gap_pct"],
+                      "host_batch_plan_fraction":
+                          chaos["chaos_host_batch_plan_fraction"],
+                      "prefetch": args.prefetch, **chaos}
         print(json.dumps(quick_line))
         if not args.skip_clients:
             # Client-scale quick line: the 1k-client baseline3 cohort
@@ -561,7 +613,8 @@ def main() -> None:
                                        train_size=1_536, test_size=512,
                                        rounds=args.rounds or 2,
                                        repeats=2, local_ep=1, model="mlp",
-                                       telemetry=tele)
+                                       telemetry=tele,
+                                       prefetch=args.prefetch)
             print(json.dumps({**popm, "quick": True}))
             quick_line.update({f"clients_{k}": v for k, v in popm.items()
                                if isinstance(v, (int, float))})
@@ -584,7 +637,8 @@ def main() -> None:
     fast = _measure(
         _config(fast=True, train_size=train_size, test_size=test_size,
                 faithful_model=faithful_model,
-                update_sharding=args.update_sharding),
+                update_sharding=args.update_sharding,
+                prefetch=args.prefetch),
         rounds, block, repeats, device_blocks=device_blocks,
         max_spread=max_spread, telemetry=tele)
     kind, peak = _device_peak_flops()
@@ -597,6 +651,13 @@ def main() -> None:
         "vs_baseline": round(fast["rounds_per_sec"]
                              / REFERENCE_ROUNDS_PER_SEC, 2),
         "update_sharding": args.update_sharding,
+        "prefetch": args.prefetch,
+        # Host-gap headline (ROADMAP lever 2): device vs wall
+        # rounds/sec when the device basis ran, else the host-timer
+        # estimate — the number the prefetch overlap must close to <5%.
+        "host_gap_pct": round(fast["host_gap_pct"], 2),
+        "host_batch_plan_fraction": round(
+            fast["host_batch_plan_fraction"], 4),
         "spread_pct": round(fast["spread_pct"], 2),
         "spread_pct_raw": round(fast["spread_pct_raw"], 2),
         "wall_retries": fast["wall_retries"],
@@ -634,7 +695,7 @@ def main() -> None:
         # (fused-scan) speed, with the pre-change per-round path timed
         # alongside so the dispatch-overhead win stays measured.
         chaos = _measure_chaos(train_size, test_size, rounds, repeats,
-                               telemetry=tele)
+                               telemetry=tele, prefetch=args.prefetch)
         result.update(chaos)
         print(f"# chaos cocktail: blocked "
               f"{chaos['gossip_rounds_per_sec_chaos']:.4f} r/s vs "
@@ -651,7 +712,8 @@ def main() -> None:
                 clients=n_clients, cohort=cohort, train_size=train_size,
                 test_size=test_size,
                 rounds=max(rounds // 4, 2) if not args.smoke else 2,
-                repeats=repeats, telemetry=tele)
+                repeats=repeats, telemetry=tele,
+                prefetch=args.prefetch)
             result[f"clients_per_sec_{n_clients // 1000}k"] = popm["value"]
             print(f"# clients/sec @ population={n_clients} "
                   f"(cohort {cohort}, {popm['waves']} waves): "

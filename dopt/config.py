@@ -177,6 +177,22 @@ class FederatedConfig:
     # bucket): small enough that several collectives are in flight for
     # the scheduler to overlap, large enough to amortise collective
     # launch overhead.
+    prefetch: str = "off"
+    # "off" | "on".  "on" overlaps the host pipeline with device
+    # compute on the blocked/chaos-blocked/population run loops: block
+    # b+1's batch plans are built and staged to device
+    # (``dopt.data.prefetch.PrefetchStager``) while block b runs —
+    # dispatch → stage-next → fetch instead of build → dispatch →
+    # fetch.  Stateful host draws (the client-sampling stream) stay on
+    # the main thread in block order and the post-fetch ledger replay
+    # consumes the drawn inputs, so prefetch-on runs are BIT-IDENTICAL
+    # to prefetch-off (History, fault ledger, telemetry canonical
+    # stream), and staging never crosses a checkpoint boundary so
+    # kill-and-resume stays exact.  "off" (the default — the
+    # oracle-parity mode) runs the exact pre-change host loop.
+    # Rejected for population mode with client-keyed quarantine armed
+    # (next round's eligibility depends on this round's screen
+    # feedback, which only exists after the fetch).
 
 
 @dataclass(frozen=True)
@@ -299,6 +315,22 @@ class GossipConfig:
     update_bucket_mb: float = 4.0
     # Scatter-mode bucket size bound (per-worker payload MB per
     # bucket); see FederatedConfig.update_bucket_mb.
+    prefetch: str = "off"
+    # "off" | "on".  "on" overlaps the host pipeline with device
+    # compute on the blocked run loops (clean, link-mode and
+    # fused-quarantine): block b+1's batch plans + stacked
+    # fault/link/corrupt inputs are built and staged to device while
+    # block b runs (``dopt.data.prefetch.PrefetchStager``).  Stateful
+    # draws (the 'gossip' matching-matrix stream) stay on the main
+    # thread in block order and the post-fetch ledger replay reuses
+    # the drawn inputs, so prefetch-on runs are BIT-IDENTICAL to
+    # prefetch-off (History, fault ledger, telemetry canonical
+    # stream); staging never crosses a checkpoint boundary, keeping
+    # kill-and-resume exact.  "off" (the default — the oracle-parity
+    # mode) runs the exact pre-change host loop.  Rejected in
+    # population mode (the gossip cohort binding mutates the registry
+    # and appends its ledger row at plan time — the federated engine
+    # is the prefetch-eligible population path).
     dropout: float = 0.0
     # DEPRECATED back-compat alias for FaultConfig(crash=p) — warns at
     # trainer construction and produces the identical fault trace

@@ -6,6 +6,7 @@ from dopt.data.partition import (assign_client_shards, holdout_split,
 from dopt.data.pipeline import (BatchPlan, eval_batches, make_batch_plan,
                                 gather_batches, sharded_eval_batches,
                                 stacked_eval_batches)
+from dopt.data.prefetch import PrefetchStager, timed_build
 
 __all__ = [
     "Dataset",
@@ -23,4 +24,6 @@ __all__ = [
     "gather_batches",
     "sharded_eval_batches",
     "stacked_eval_batches",
+    "PrefetchStager",
+    "timed_build",
 ]

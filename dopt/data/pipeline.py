@@ -114,28 +114,41 @@ def make_batch_plan(
         padded = steps_per_epoch * bs
     s = local_ep * steps_per_epoch
 
-    idx = np.empty((w, s, bs), dtype=np.int32)
-    weight = np.empty((w, s, bs), dtype=np.float32)
+    # Per-(worker, epoch) permutations keep their SeedSequence keys —
+    # the (seed, round, ep, wid) keying is the byte-identity contract
+    # with the torch oracle and every historical plan — but everything
+    # downstream of the draws (wraparound padding, the gather from
+    # index_matrix, the [W, S, B] reshape) runs as batched numpy ops
+    # over the whole fleet instead of an O(W) python loop: the RNG
+    # draws are the only remaining per-worker python work, and they are
+    # one C call each.
+    pad = padded - l
+    perms = np.empty((w, local_ep, padded), dtype=np.int64)
     for wi in range(w):
         wid = int(worker_ids[wi]) if worker_ids is not None else wi
-        rows_i = []
-        mask_i = []
         for ep in range(local_ep):
             rng = np.random.default_rng(
                 np.random.SeedSequence([seed, round_idx, ep, wid])
             )
             perm = rng.permutation(l)
             if drop_last:
-                perm = perm[:padded]
-                mask = np.ones(padded, np.float32)
+                perms[wi, ep] = perm[:padded]
+            elif pad:
+                perms[wi, ep, :l] = perm
+                perms[wi, ep, l:] = perm[:pad]
             else:
-                pad = padded - l
-                mask = np.concatenate([np.ones(l, np.float32), np.zeros(pad, np.float32)])
-                perm = np.concatenate([perm, perm[:pad]]) if pad else perm
-            rows_i.append(index_matrix[wi][perm].reshape(steps_per_epoch, bs))
-            mask_i.append(mask.reshape(steps_per_epoch, bs))
-        idx[wi] = np.concatenate(rows_i, axis=0)
-        weight[wi] = np.concatenate(mask_i, axis=0)
+                perms[wi, ep] = perm
+    # One gather for the fleet: [W, 1, L] rows indexed by [W, E, padded].
+    gathered = np.take_along_axis(index_matrix[:, None, :], perms, axis=2)
+    idx = np.ascontiguousarray(
+        gathered.reshape(w, s, bs).astype(np.int32, copy=False))
+    if drop_last or pad == 0:
+        weight = np.ones((w, s, bs), np.float32)
+    else:
+        epoch_mask = np.concatenate(
+            [np.ones(l, np.float32), np.zeros(pad, np.float32)]
+        ).reshape(steps_per_epoch, bs)
+        weight = np.tile(epoch_mask[None], (w, local_ep, 1)).reshape(w, s, bs)
     return BatchPlan(idx=idx, weight=weight)
 
 

@@ -38,8 +38,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dopt.config import ExperimentConfig
-from dopt.data import (eval_batches, load_dataset, make_batch_plan,
-                       partition, stacked_eval_batches)
+from dopt.data import (PrefetchStager, eval_batches, load_dataset,
+                       make_batch_plan, partition, stacked_eval_batches,
+                       timed_build)
 from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                flat_input_stacked_apply, make_evaluator,
                                make_stacked_local_update,
@@ -55,7 +56,8 @@ from dopt.parallel.collectives import (broadcast_to_workers,
                                         where_mask as _where_mask)
 from dopt.robust import (clip_to_ball, finite_lane_mask, make_aggregator,
                          masked_mean, validate_robust_config)
-from dopt.parallel.mesh import make_worker_mesh, shard_worker_tree, worker_sharding
+from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
+                                worker_axes, worker_sharding)
 from dopt.utils.metrics import History
 from dopt.utils.profiling import PhaseTimers
 from dopt.utils.prng import host_rng
@@ -341,6 +343,22 @@ class FederatedTrainer:
             # Quarantine is CLIENT-keyed in population mode (the
             # registry's streaks); the lane-keyed machinery stays dark.
             self._quarantine_on = False
+
+        # Prefetched host pipeline (dopt.data.prefetch): "on" makes the
+        # blocked/chaos-blocked/population loops stage round/block b+1's
+        # batch plans + participation inputs while b runs on device.
+        # "off" (default) is the exact pre-change host loop.
+        if f.prefetch not in ("off", "on"):
+            raise ValueError(
+                f"unknown prefetch {f.prefetch!r}; one of off|on")
+        self._prefetch = f.prefetch == "on"
+        if (self._prefetch and self._registry is not None
+                and rcfg is not None and rcfg.quarantine_after > 0):
+            raise ValueError(
+                "prefetch='on' does not compose with population-mode "
+                "client quarantine: round t+1's cohort eligibility "
+                "depends on round t's screen feedback, which only "
+                "exists after the fetch — drop one of the two")
 
         self.dataset = load_dataset(
             cfg.data.dataset, data_dir=cfg.data.data_dir,
@@ -1630,48 +1648,113 @@ class FederatedTrainer:
                     rows.append({"round": int(t), "worker": int(i),
                                  "kind": "corrupt",
                                  "action": f"injected_{mode}"})
-        reg.record_participation(t, binding.survivors)
+        # NOTE: participation is recorded at the loop's post-fetch
+        # COMMIT point (next to the screen feedback), not here: the
+        # prefetched loop draws round t+1's cohort before round t's
+        # commit, and the registry counters the telemetry gauges read
+        # must reflect only committed rounds on both paths.  Sampling
+        # itself never reads the counters, so the move is unobservable
+        # to the draw.
         return binding, limits, cmask, rows
+
+    def _draw_pop_round(self, t: int) -> dict:
+        """Stateful half of one population round's staging: the cohort
+        participation chain (registry eligibility reads + the fault
+        draws).  Main thread, round order (prefetch ordering
+        contract)."""
+        binding, limits, cmask, rows = self._cohort_participation(t)
+        return {"t": t, "binding": binding, "rows": rows,
+                "cmask": cmask, "valids": jnp.asarray(binding.valid),
+                "lim": jnp.asarray(limits)}
+
+    def _build_pop_round(self, meta: dict) -> dict:
+        """Pure half: the K wave plans + their device staging (safe on
+        the stager thread — every input is stateless in the round)."""
+        cfg, f, reg = self.cfg, self.cfg.federated, self._registry
+        t, binding = meta["t"], meta["binding"]
+        pm = reg.plan_matrix_for(t, self._train_matrix)
+        plans = [
+            make_batch_plan(
+                pm, batch_size=f.local_bs, local_ep=f.local_ep,
+                seed=cfg.seed, round_idx=t,
+                impl=cfg.data.plan_impl,
+                workers=binding.lane_ids[k],
+                rows=reg.shard_of[binding.lane_ids[k]])
+            for k in range(binding.waves)
+        ]
+        meta["idx"] = jax.device_put(np.stack([p.idx for p in plans]),
+                                     self._pop_sharding)
+        meta["bw"] = jax.device_put(np.stack([p.weight for p in plans]),
+                                    self._pop_sharding)
+        return meta
 
     def _run_population(self, rounds: int, checkpoint_every: int = 0,
                         checkpoint_path=None) -> History:
         """Population-mode training loop: one jitted wave-scan dispatch
         per round (the K-wave scan already amortises dispatch the way
         blocked execution does for the lane engines; cohort size never
-        retraces)."""
-        cfg, f = self.cfg, self.cfg.federated
-        reg = self._registry
+        retraces).  With ``prefetch='on'`` the loop runs dispatch →
+        stage-next → fetch: round t+1's cohort is drawn (main thread)
+        and its wave plans built/staged (background thread) while round
+        t runs; participation is committed post-fetch, staging never
+        crosses a checkpoint boundary, and client quarantine was
+        rejected at construction (its eligibility feedback only exists
+        after the fetch)."""
         t0 = time.time()
-        for _ in range(rounds):
+        stager = PrefetchStager() if self._prefetch else None
+        try:
+            self._population_loop(rounds, checkpoint_every,
+                                  checkpoint_path, stager)
+        finally:
+            if stager is not None:
+                stager.discard()
+        self.total_time = time.time() - t0
+        self._run_summary_telemetry()
+        return self.history
+
+    def _population_loop(self, rounds: int, checkpoint_every: int,
+                         checkpoint_path, stager) -> None:
+        reg = self._registry
+        for r in range(rounds):
             t = self.round
-            with self.timers.phase("host_batch_plan"):
-                binding, limits, cmask, rows = self._cohort_participation(t)
-                pm = reg.plan_matrix_for(t, self._train_matrix)
-                plans = [
-                    make_batch_plan(
-                        pm, batch_size=f.local_bs, local_ep=f.local_ep,
-                        seed=cfg.seed, round_idx=t,
-                        impl=cfg.data.plan_impl,
-                        workers=binding.lane_ids[k],
-                        rows=reg.shard_of[binding.lane_ids[k]])
-                    for k in range(binding.waves)
-                ]
-                idx = jax.device_put(np.stack([p.idx for p in plans]),
-                                     self._pop_sharding)
-                bw = jax.device_put(np.stack([p.weight for p in plans]),
-                                    self._pop_sharding)
-                valids = jnp.asarray(binding.valid)
-                lim = jnp.asarray(limits)
-            step_kw = ({"cmasks": jnp.asarray(cmask)}
+            payload = stager.take(t) if stager is not None else None
+            if payload is None:
+                with self.timers.phase("host_batch_plan"):
+                    payload = self._build_pop_round(
+                        self._draw_pop_round(t))
+            binding, rows = payload["binding"], payload["rows"]
+            step_kw = ({"cmasks": jnp.asarray(payload["cmask"])}
                        if self._has_corrupt else {})
-            self.theta, packed = self.timers.measure(
-                "round_step", self._pop_round_fn,
-                self.theta, idx, bw, valids, lim,
-                self._train_x, self._train_y, *self._eval, **step_kw)
+            args = (self.theta, payload["idx"], payload["bw"],
+                    payload["valids"], payload["lim"], self._train_x,
+                    self._train_y, *self._eval)
+            if stager is None:
+                self.theta, packed = self.timers.measure(
+                    "round_step", self._pop_round_fn, *args, **step_kw)
+            else:
+                with self.timers.phase("round_step"):
+                    out = self._pop_round_fn(*args, **step_kw)
+                    ckpt_next = (checkpoint_every
+                                 and (t + 1) % checkpoint_every == 0)
+                    if r + 1 < rounds and not ckpt_next:
+                        with self.timers.phase("host_batch_plan"):
+                            meta = self._draw_pop_round(t + 1)
+                        stager.stage(
+                            t + 1,
+                            timed_build(self._build_pop_round,
+                                        self.timers),
+                            meta)
+                    jax.block_until_ready(out)
+                self.theta, packed = out
             packed = np.asarray(packed)   # ONE device→host fetch/round
             ll, acc, loss_sum, t_loss, t_acc = (float(v)
                                                 for v in packed[:5])
             n = len(binding.survivors)
+            # COMMIT: the registry counters advance only here, post-
+            # fetch — identical state at every observable point
+            # (gauges, checkpoints) on both the prefetched and the
+            # unprefetched path.
+            reg.record_participation(t, binding.survivors)
             # Survivors occupy the first n wave-major slots; padding
             # lanes' flags are discarded like compact padding lanes'.
             flags = packed[5:].reshape(-1)[:n]
@@ -1691,9 +1774,6 @@ class FederatedTrainer:
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:
                 self.save(checkpoint_path)
-        self.total_time = time.time() - t0
-        self._run_summary_telemetry()
-        return self.history
 
     def _run_blocked(self, frac: float, rounds: int, block: int,
                      checkpoint_every: int = 0,
@@ -1703,74 +1783,115 @@ class FederatedTrainer:
         only exists on the host there).  Compact + faults runs
         fixed-width validity-masked lanes; quarantine / staleness runs
         route to ``_run_blocked_chaos`` (their round-to-round state is
-        scan carry)."""
-        from dopt.parallel.mesh import worker_axes
-
+        scan carry).  With ``prefetch='on'`` both loops run dispatch →
+        stage-next → fetch: the next block's participation draws stay
+        on the main thread (in block order, so the sampling stream is
+        byte-identical) and its plan build + device staging overlap the
+        current block's device time; staging never crosses a scheduled
+        checkpoint boundary."""
         if self._quarantine_on or self._has_stale:
             # Both force the full-width path (run() keeps
             # compact+quarantine per-round; staleness rejects compact).
             return self._run_blocked_chaos(
                 frac, rounds, block, checkpoint_every=checkpoint_every,
                 checkpoint_path=checkpoint_path)
-        cfg, f = self.cfg, self.cfg.federated
         compact = self._use_compact(frac)
         fixed_c = compact and self.faults.active
-        block_sharding = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
-        )
         t0 = time.time()
-        done = 0
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
+        stager = PrefetchStager() if self._prefetch else None
+        try:
+            self._blocked_loop(frac, rounds, block, next_ckpt,
+                               checkpoint_every, checkpoint_path, stager,
+                               compact, fixed_c)
+        finally:
+            if stager is not None:
+                stager.discard()
+        self.total_time = time.time() - t0
+        self._run_summary_telemetry()
+        return self.history
+
+    def _draw_block(self, ts: list, frac: float, compact: bool,
+                    fixed_c: bool) -> dict:
+        """Stateful half of one plain-blocked block's staging: the
+        participation draws (the client-sampling RNG stream advances
+        here, in block order — the prefetch ordering contract)."""
+        parts = [self._round_participation(t, frac) for t in ts]
+        sels = [p[0] for p in parts]
+        frows = [p[3] for p in parts]
+        if fixed_c:
+            fw = [self._fixed_width_sel(sel, frac) for sel in sels]
+            lane_sels = [x[0] for x in fw]
+            valids = jnp.asarray(np.stack([x[1] for x in fw]))
+        else:
+            lane_sels = sels
+            valids = None
+        if self._has_corrupt:
+            # [k, lanes] corrupt masks: full-width rounds stack the [W]
+            # masks directly, fixed-width compact rounds gather their
+            # lane slice (padding ids carry no lie — the host only
+            # flags survivors/captured).
+            cms = jnp.asarray(np.stack(
+                [p[2][ls] for p, ls in zip(parts, lane_sels)]
+                if compact else [p[2] for p in parts]))
+        else:
+            cms = None
+        if compact:
+            gates = jnp.asarray(np.stack(lane_sels))
+            limits = jnp.asarray(np.stack(
+                [p[1][ls] for ls, p in zip(lane_sels, parts)]))
+        else:
+            masks = np.zeros((len(ts), self.num_workers), np.float32)
+            for j, sel in enumerate(sels):
+                masks[j, sel] = 1.0
+            gates = jnp.asarray(masks)
+            limits = jnp.asarray(np.stack([p[1] for p in parts]))
+        return {"ts": ts, "compact": compact, "sels": sels,
+                "frows": frows, "lane_sels": lane_sels, "valids": valids,
+                "cms": cms, "gates": gates, "limits": limits}
+
+    def _build_block(self, meta: dict) -> dict:
+        """Pure half: the block's batch plans + device staging (safe on
+        the stager thread)."""
+        cfg, f = self.cfg, self.cfg.federated
+        ts, compact = meta["ts"], meta["compact"]
+        plans = [
+            make_batch_plan(
+                self._plan_matrix_for_round(t), batch_size=f.local_bs,
+                local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
+                impl=cfg.data.plan_impl,
+                workers=lane_sel if compact else None,
+            )
+            for t, lane_sel in zip(ts, meta["lane_sels"])
+        ]
+        if compact:
+            meta["idx"] = jnp.asarray(np.stack([p.idx for p in plans]))
+            meta["bw"] = jnp.asarray(np.stack([p.weight for p in plans]))
+        else:
+            block_sharding = jax.sharding.NamedSharding(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, worker_axes(self.mesh)))
+            meta["idx"] = jax.device_put(
+                np.stack([p.idx for p in plans]), block_sharding)
+            meta["bw"] = jax.device_put(
+                np.stack([p.weight for p in plans]), block_sharding)
+        return meta
+
+    def _blocked_loop(self, frac, rounds, block, next_ckpt,
+                      checkpoint_every, checkpoint_path, stager,
+                      compact, fixed_c) -> None:
+        done = 0
         while done < rounds:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
-            with self.timers.phase("host_batch_plan"):
-                parts = [self._round_participation(t, frac) for t in ts]
-                sels = [p[0] for p in parts]
-                frows = [p[3] for p in parts]
-                if fixed_c:
-                    fw = [self._fixed_width_sel(sel, frac) for sel in sels]
-                    lane_sels = [x[0] for x in fw]
-                    valids = jnp.asarray(np.stack([x[1] for x in fw]))
-                else:
-                    lane_sels = sels
-                    valids = None
-                plans = [
-                    make_batch_plan(
-                        self._plan_matrix_for_round(t), batch_size=f.local_bs,
-                        local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
-                        impl=cfg.data.plan_impl,
-                        workers=lane_sel if compact else None,
-                    )
-                    for t, lane_sel in zip(ts, lane_sels)
-                ]
-                if self._has_corrupt:
-                    # [k, lanes] corrupt masks: full-width rounds stack
-                    # the [W] masks directly, fixed-width compact rounds
-                    # gather their lane slice (padding ids carry no lie
-                    # — the host only flags survivors/captured).
-                    cms = jnp.asarray(np.stack(
-                        [p[2][ls] for p, ls in zip(parts, lane_sels)]
-                        if compact else [p[2] for p in parts]))
-                else:
-                    cms = None
-                if compact:
-                    gates = jnp.asarray(np.stack(lane_sels))
-                    limits = jnp.asarray(np.stack(
-                        [p[1][ls] for ls, p in zip(lane_sels, parts)]))
-                    idx = jnp.asarray(np.stack([p.idx for p in plans]))
-                    bw = jnp.asarray(np.stack([p.weight for p in plans]))
-                else:
-                    masks = np.zeros((k, self.num_workers), np.float32)
-                    for j, sel in enumerate(sels):
-                        masks[j, sel] = 1.0
-                    gates = jnp.asarray(masks)
-                    limits = jnp.asarray(np.stack([p[1] for p in parts]))
-                    idx = jax.device_put(np.stack([p.idx for p in plans]),
-                                         block_sharding)
-                    bw = jax.device_put(np.stack([p.weight for p in plans]),
-                                        block_sharding)
+            payload = stager.take(ts[0]) if stager is not None else None
+            if payload is None:
+                with self.timers.phase("host_batch_plan"):
+                    payload = self._build_block(
+                        self._draw_block(ts, frac, compact, fixed_c))
+            sels, frows = payload["sels"], payload["frows"]
+            lane_sels = payload["lane_sels"]
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
             fn = (self._compact_fault_block_fn if fixed_c
@@ -1778,18 +1899,39 @@ class FederatedTrainer:
                   else self._block_fn)
             step_kw = {}
             if self._has_corrupt:
-                step_kw["cmasks"] = cms
+                step_kw["cmasks"] = payload["cms"]
             if fixed_c:
-                step_kw["valids"] = valids
+                step_kw["valids"] = payload["valids"]
+            args = (self.theta, self.params, self.momentum, duals_in,
+                    c_in, payload["gates"], payload["limits"],
+                    payload["idx"], payload["bw"], self._train_x,
+                    self._train_y, *self._eval, self._train_eval_idx,
+                    self._train_eval_w, *self._val)
+            if stager is None:
+                out = self.timers.measure("round_step", fn, *args,
+                                          **step_kw)
+            else:
+                # dispatch → stage-next → fetch (see gossip.py): the
+                # next block's participation draw stays on this thread,
+                # its plan build overlaps this block's device time.
+                with self.timers.phase("round_step"):
+                    out = fn(*args, **step_kw)
+                    end_round = ts[-1] + 1
+                    remaining = rounds - (done + k)
+                    if remaining > 0 and (next_ckpt is None
+                                          or end_round < next_ckpt):
+                        nk = min(block, remaining)
+                        nts = [end_round + j for j in range(nk)]
+                        with self.timers.phase("host_batch_plan"):
+                            meta = self._draw_block(nts, frac, compact,
+                                                    fixed_c)
+                        stager.stage(
+                            nts[0],
+                            timed_build(self._build_block, self.timers),
+                            meta)
+                    jax.block_until_ready(out)
             (self.theta, self.params, self.momentum, new_duals, new_c,
-             packed) = self.timers.measure(
-                "round_step", fn,
-                self.theta, self.params, self.momentum, duals_in, c_in,
-                gates, limits, idx, bw, self._train_x, self._train_y,
-                *self._eval,
-                self._train_eval_idx, self._train_eval_w, *self._val,
-                **step_kw,
-            )
+             packed) = out
             if self.duals is not None:
                 self.duals = new_duals
             if self.c_global is not None:
@@ -1822,9 +1964,6 @@ class FederatedTrainer:
                 self.save(checkpoint_path)
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
-        self.total_time = time.time() - t0
-        self._run_summary_telemetry()
-        return self.history
 
     def _run_blocked_chaos(self, frac: float, rounds: int, block: int,
                            checkpoint_every: int = 0,
@@ -1837,60 +1976,98 @@ class FederatedTrainer:
         host replays the identical integer logic post-fetch so the
         ledger rows (and their order) are bit-identical to per-round
         execution."""
-        from dopt.parallel.mesh import worker_axes
-
-        cfg, f = self.cfg, self.cfg.federated
         w = self.num_workers
         m = max(int(frac * w), 1)
-        block_sharding = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
-        )
         t0 = time.time()
-        done = 0
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
+        stager = PrefetchStager() if self._prefetch else None
+        try:
+            self._blocked_chaos_loop(frac, rounds, block, m, next_ckpt,
+                                     checkpoint_every, checkpoint_path,
+                                     stager)
+        finally:
+            if stager is not None:
+                stager.discard()
+        self.total_time = time.time() - t0
+        self._run_summary_telemetry()
+        return self.history
+
+    def _draw_chaos_block(self, ts: list, frac: float) -> dict:
+        """Stateful half of one chaos block's staging: the candidate
+        draws (sampling RNG, in block order) + the stateless per-round
+        fault vectors.  Touches no quarantine/staleness state
+        (``_participation_static``'s contract), so drawing block b+1
+        before block b's post-fetch replay is exact."""
+        stat = [self._participation_static(t, frac) for t in ts]
+        return {"ts": ts, "compact": False,
+                "lane_sels": [None] * len(ts),
+                "chosen": np.stack([s["chosen"] for s in stat]),
+                "stacks": {key: jnp.asarray(
+                               np.stack([s[key] for s in stat]))
+                           for key in ("away", "crashed", "unreach",
+                                       "straggler", "up_drop",
+                                       "up_delay", "late_d", "limits",
+                                       "corrupt")}}
+
+    def _blocked_chaos_loop(self, frac, rounds, block, m, next_ckpt,
+                            checkpoint_every, checkpoint_path,
+                            stager) -> None:
+        w = self.num_workers
+        done = 0
         while done < rounds:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
-            with self.timers.phase("host_batch_plan"):
-                stat = [self._participation_static(t, frac) for t in ts]
-                chosen = np.stack([s["chosen"] for s in stat])
-                stacks = {key: jnp.asarray(np.stack([s[key] for s in stat]))
-                          for key in ("away", "crashed", "unreach",
-                                      "straggler", "up_drop", "up_delay",
-                                      "late_d", "limits", "corrupt")}
-                plans = [
-                    make_batch_plan(
-                        self._plan_matrix_for_round(t), batch_size=f.local_bs,
-                        local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
-                        impl=cfg.data.plan_impl)
-                    for t in ts
-                ]
-                idx = jax.device_put(np.stack([p.idx for p in plans]),
-                                     block_sharding)
-                bw = jax.device_put(np.stack([p.weight for p in plans]),
-                                    block_sharding)
+            payload = stager.take(ts[0]) if stager is not None else None
+            if payload is None:
+                with self.timers.phase("host_batch_plan"):
+                    payload = self._build_block(
+                        self._draw_chaos_block(ts, frac))
+            chosen, stacks = payload["chosen"], payload["stacks"]
             duals_in = self.duals if self.duals is not None else {}
             c_in = self.c_global if self.c_global is not None else {}
             sp_in = self._stale_p if self._has_stale else {}
+            args = (self.theta, self.params, self.momentum, duals_in,
+                    c_in,
+                    jnp.asarray(self._screen_streak.astype(np.int32)),
+                    jnp.asarray(self._quarantine_until.astype(np.int32)),
+                    jnp.asarray(self._stale_admit_round.astype(np.int32)),
+                    jnp.asarray(self._stale_weight.astype(np.float32)),
+                    sp_in, jnp.asarray(m, jnp.int32),
+                    jnp.asarray(ts, jnp.int32), jnp.asarray(chosen),
+                    stacks["away"], stacks["crashed"], stacks["unreach"],
+                    stacks["straggler"], stacks["up_drop"],
+                    stacks["up_delay"], stacks["late_d"],
+                    stacks["limits"], stacks["corrupt"], payload["idx"],
+                    payload["bw"], self._train_x, self._train_y,
+                    *self._eval,
+                    self._train_eval_idx, self._train_eval_w,
+                    *self._val)
+            if stager is None:
+                out = self.timers.measure("round_step",
+                                          self._chaos_block_fn, *args)
+            else:
+                # dispatch → stage-next → fetch; note the carry inputs
+                # (streaks, admission schedule) above are read at
+                # DISPATCH time, after the previous block's replay —
+                # only the plan payload is staged ahead.
+                with self.timers.phase("round_step"):
+                    out = self._chaos_block_fn(*args)
+                    end_round = ts[-1] + 1
+                    remaining = rounds - (done + k)
+                    if remaining > 0 and (next_ckpt is None
+                                          or end_round < next_ckpt):
+                        nk = min(block, remaining)
+                        nts = [end_round + j for j in range(nk)]
+                        with self.timers.phase("host_batch_plan"):
+                            meta = self._draw_chaos_block(nts, frac)
+                        stager.stage(
+                            nts[0],
+                            timed_build(self._build_block, self.timers),
+                            meta)
+                    jax.block_until_ready(out)
             (self.theta, self.params, self.momentum, new_duals, new_c,
-             dev_stk, dev_unt, dev_sta, dev_stw, new_sp,
-             packed) = self.timers.measure(
-                "round_step", self._chaos_block_fn,
-                self.theta, self.params, self.momentum, duals_in, c_in,
-                jnp.asarray(self._screen_streak.astype(np.int32)),
-                jnp.asarray(self._quarantine_until.astype(np.int32)),
-                jnp.asarray(self._stale_admit_round.astype(np.int32)),
-                jnp.asarray(self._stale_weight.astype(np.float32)),
-                sp_in, jnp.asarray(m, jnp.int32),
-                jnp.asarray(ts, jnp.int32), jnp.asarray(chosen),
-                stacks["away"], stacks["crashed"], stacks["unreach"],
-                stacks["straggler"], stacks["up_drop"],
-                stacks["up_delay"], stacks["late_d"], stacks["limits"],
-                stacks["corrupt"], idx, bw, self._train_x, self._train_y,
-                *self._eval,
-                self._train_eval_idx, self._train_eval_w, *self._val,
-            )
+             dev_stk, dev_unt, dev_sta, dev_stw, new_sp, packed) = out
             if self.duals is not None:
                 self.duals = new_duals
             if self.c_global is not None:
@@ -1951,9 +2128,6 @@ class FederatedTrainer:
                 self.save(checkpoint_path)
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
-        self.total_time = time.time() - t0
-        self._run_summary_telemetry()
-        return self.history
 
     def run(self, frac: float | None = None, rounds: int | None = None,
             block: int | None = None, checkpoint_every: int = 0,

@@ -31,8 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dopt.config import ExperimentConfig
-from dopt.data import (eval_batches, load_dataset, make_batch_plan,
-                       partition, sharded_eval_batches)
+from dopt.data import (PrefetchStager, eval_batches, load_dataset,
+                       make_batch_plan, partition, sharded_eval_batches,
+                       timed_build)
 from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                flat_input_stacked_apply, make_evaluator,
                                make_stacked_evaluator, make_stacked_local_update,
@@ -310,6 +311,22 @@ class GossipTrainer:
                     "keyed) — drop one of the two")
             self._registry = ClientRegistry(pop, num_shards=w,
                                             seed=cfg.seed, lanes=w)
+
+        # Prefetched host pipeline (dopt.data.prefetch): "on" makes the
+        # blocked loops stage block b+1's plans + fault inputs while
+        # block b runs on device.  "off" (default) is the exact
+        # pre-change host loop — the oracle-parity mode.
+        if g.prefetch not in ("off", "on"):
+            raise ValueError(
+                f"unknown prefetch {g.prefetch!r}; one of off|on")
+        self._prefetch = g.prefetch == "on"
+        if self._prefetch and self._registry is not None:
+            raise ValueError(
+                "prefetch='on' does not compose with gossip population "
+                "mode (the cohort binding mutates the registry and "
+                "appends its ledger row at plan time, which a staged "
+                "build must not do) — the federated engine is the "
+                "prefetch-eligible population path")
 
         # Byzantine threat model (dopt.robust): workers can LIE on the
         # wire — their broadcast state is corrupted inside the jitted
@@ -1148,6 +1165,60 @@ class GossipTrainer:
             self._link_block_fn = jax.jit(link_block_fn,
                                           donate_argnums=(0, 1, 2, 3, 4))
 
+    # -- blocked staging: the stateful draw vs the pure build ----------
+    def _draw_block(self, ts: list) -> dict:
+        """The STATEFUL half of one block's host staging: the per-round
+        fault/matrix/quarantine inputs.  Always runs on the main thread
+        in block order — the 'gossip' matching-matrix RNG and the
+        link-mode quarantine-expiry mutations must advance at exactly
+        the sequence positions the unprefetched loop consumes them at
+        (dopt.data.prefetch ordering contract)."""
+        if self._fused_quar:
+            statics = [self._round_inputs_static(t) for t in ts]
+            return {"ts": ts,
+                    "w_raws": [s[0] for s in statics],
+                    "w_mats": np.stack([s[1] for s in statics]),
+                    "alive": np.stack([s[2] for s in statics]),
+                    "limits": np.stack([s[3] for s in statics]),
+                    "cmasks": (np.stack([s[4] for s in statics])
+                               if self._has_corrupt else None),
+                    "frows": None}
+        pairs = [self._round_inputs(t) for t in ts]
+        return {"ts": ts,
+                "w_raws": None,
+                "w_mats": np.stack([p[0] for p in pairs]),
+                "alive": np.stack([p[1] for p in pairs]),
+                "limits": np.stack([p[2] for p in pairs]),
+                "cmasks": (np.stack([p[3] for p in pairs])
+                           if self._has_corrupt else None),
+                "frows": [p[4] for p in pairs]}
+
+    def _build_block(self, meta: dict) -> dict:
+        """The PURE half of one block's host staging: the batch plans
+        (the expensive O(W·S·B) host work) and their device staging.
+        Touches no trainer state beyond stateless reads, so the
+        prefetch stager may run it on its background thread."""
+        ts = meta["ts"]
+        block_sharding = jax.sharding.NamedSharding(
+            self.mesh,
+            jax.sharding.PartitionSpec(None, worker_axes(self.mesh)))
+        plans = [self._round_plan(t) for t in ts]
+        meta["idx"] = jax.device_put(np.stack([p.idx for p in plans]),
+                                     block_sharding)
+        meta["bw"] = jax.device_put(np.stack([p.weight for p in plans]),
+                                    block_sharding)
+        meta["is_eval"] = np.asarray(
+            [(t % self.eval_every) == 0 for t in ts], dtype=bool)
+        return meta
+
+    def _stage_block(self, stager: PrefetchStager, ts: list) -> None:
+        """Draw block ``ts``'s inputs now (main thread, in order) and
+        hand the pure build to the stager's background thread."""
+        with self.timers.phase("host_batch_plan"):
+            meta = self._draw_block(ts)
+        stager.stage(ts[0], timed_build(self._build_block, self.timers),
+                     meta)
+
     def _run_blocked(self, rounds: int, block: int,
                      checkpoint_every: int = 0,
                      checkpoint_path=None) -> History:
@@ -1162,78 +1233,94 @@ class GossipTrainer:
         runs carry the streak/until state on device and the host
         REPLAYS the per-round ledger logic post-fetch (same rows, same
         order — the screened flags it needs only exist after the block
-        lands)."""
-        cfg, g = self.cfg, self.cfg.gossip
+        lands).
+
+        With ``prefetch='on'`` the loop runs dispatch → stage-next →
+        fetch: block b's dispatch is asynchronous, block b+1's plans
+        are drawn (main thread, in order) and built/staged (background
+        thread) while b runs on device, and the fetch barrier lands
+        after staging started.  Staging never crosses a scheduled
+        checkpoint boundary — the block after a checkpoint builds
+        inline from the committed state — so checkpoints capture
+        exactly the committed rounds and resume stays bit-exact.
+        ``prefetch='off'`` runs the exact pre-change host loop."""
         link = self._link_mode
         fused_quar = self._fused_quar
-        block_sharding = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(None, worker_axes(self.mesh))
-        )
         t0 = time.time()
-        done = 0
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
+        stager = PrefetchStager() if self._prefetch else None
+        try:
+            self._blocked_loop(rounds, block, next_ckpt, checkpoint_every,
+                               checkpoint_path, stager, link, fused_quar)
+        finally:
+            if stager is not None:
+                stager.discard()
+        self.total_time = time.time() - t0
+        self._run_summary_telemetry()
+        return self.history
+
+    def _blocked_loop(self, rounds, block, next_ckpt, checkpoint_every,
+                      checkpoint_path, stager, link, fused_quar) -> None:
+        done = 0
         while done < rounds:
             k = min(block, rounds - done)
             ts = [self.round + j for j in range(k)]
-            with self.timers.phase("host_batch_plan"):
-                if fused_quar:
-                    statics = [self._round_inputs_static(t) for t in ts]
-                    w_raws = [s[0] for s in statics]
-                    w_mats = np.stack([s[1] for s in statics])
-                    alive = np.stack([s[2] for s in statics])
-                    limits = np.stack([s[3] for s in statics])
-                    cmasks = (np.stack([s[4] for s in statics])
-                              if self._has_corrupt else None)
-                    frows = None
-                else:
-                    pairs = [self._round_inputs(t) for t in ts]
-                    w_mats = np.stack([p[0] for p in pairs])
-                    alive = np.stack([p[1] for p in pairs])
-                    limits = np.stack([p[2] for p in pairs])
-                    cmasks = (np.stack([p[3] for p in pairs])
-                              if self._has_corrupt else None)
-                    frows = [p[4] for p in pairs]
-                plans = [self._round_plan(t) for t in ts]
-                idx = jax.device_put(np.stack([p.idx for p in plans]),
-                                     block_sharding)
-                bw = jax.device_put(np.stack([p.weight for p in plans]),
-                                    block_sharding)
-            is_eval = np.asarray(
-                [(t % self.eval_every) == 0 for t in ts], dtype=bool
-            )
-            step_kw = ({"cmasks": jnp.asarray(cmasks)}
+            payload = stager.take(ts[0]) if stager is not None else None
+            if payload is None:
+                with self.timers.phase("host_batch_plan"):
+                    payload = self._build_block(self._draw_block(ts))
+            w_raws, frows = payload["w_raws"], payload["frows"]
+            alive, is_eval = payload["alive"], payload["is_eval"]
+            step_kw = ({"cmasks": jnp.asarray(payload["cmasks"])}
                        if self._has_corrupt else {})
-            common = (w_mats, alive, limits, jnp.asarray(ts, jnp.int32),
-                      idx, bw, jnp.asarray(is_eval), self._train_x,
+            common = (payload["w_mats"], alive, payload["limits"],
+                      jnp.asarray(ts, jnp.int32), payload["idx"],
+                      payload["bw"], jnp.asarray(is_eval), self._train_x,
                       self._train_y, *self._eval, *self._val)
             if link:
-                (self.params, self.momentum, self._mass, self._link_buf,
-                 self._link_buf_mass, packed) = self.timers.measure(
-                    "round_step", self._link_block_fn,
-                    self.params, self.momentum, self._mass,
-                    self._link_buf, self._link_buf_mass, *common,
-                    **step_kw,
-                )
+                fn = self._link_block_fn
+                args = (self.params, self.momentum, self._mass,
+                        self._link_buf, self._link_buf_mass, *common)
             elif fused_quar:
                 step_kw.update(
                     streak=jnp.asarray(
                         self._screen_streak.astype(np.int32)),
                     until=jnp.asarray(
                         self._quarantine_until.astype(np.int32)))
-                (self.params, self.momentum, self.x_hat, dev_streak,
-                 dev_until, packed) = self.timers.measure(
-                    "round_step", self._block_fn,
-                    self.params, self.momentum, self.x_hat, *common,
-                    **step_kw,
-                )
+                fn = self._block_fn
+                args = (self.params, self.momentum, self.x_hat, *common)
             else:
-                (self.params, self.momentum, self.x_hat,
-                 packed) = self.timers.measure(
-                    "round_step", self._block_fn,
-                    self.params, self.momentum, self.x_hat, *common,
-                    **step_kw,
-                )
+                fn = self._block_fn
+                args = (self.params, self.momentum, self.x_hat, *common)
+            if stager is None:
+                out = self.timers.measure("round_step", fn, *args,
+                                          **step_kw)
+            else:
+                # dispatch → stage-next → fetch: the jit dispatch
+                # returns before the device finishes, the next block's
+                # staging overlaps this block's device time, and
+                # block_until_ready is the fetch barrier the old
+                # measure() call provided.
+                with self.timers.phase("round_step"):
+                    out = fn(*args, **step_kw)
+                    end_round = ts[-1] + 1
+                    remaining = rounds - (done + k)
+                    if remaining > 0 and (next_ckpt is None
+                                          or end_round < next_ckpt):
+                        nk = min(block, remaining)
+                        self._stage_block(
+                            stager, [end_round + j for j in range(nk)])
+                    jax.block_until_ready(out)
+            dev_streak = dev_until = None
+            if link:
+                (self.params, self.momentum, self._mass, self._link_buf,
+                 self._link_buf_mass, packed) = out
+            elif fused_quar:
+                (self.params, self.momentum, self.x_hat, dev_streak,
+                 dev_until, packed) = out
+            else:
+                (self.params, self.momentum, self.x_hat, packed) = out
             packed = np.asarray(packed)  # ONE device→host fetch per block
             for j, t in enumerate(ts):
                 tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
@@ -1284,9 +1371,6 @@ class GossipTrainer:
                 self.save(checkpoint_path)
                 next_ckpt = (self.round // checkpoint_every + 1) \
                     * checkpoint_every
-        self.total_time = time.time() - t0
-        self._run_summary_telemetry()
-        return self.history
 
     # ------------------------------------------------------------------
     def _unpack_host_metrics(self, vec: np.ndarray):

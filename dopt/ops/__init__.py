@@ -1,11 +1,17 @@
 from dopt.ops.fused_update import (
+    fused_mix_sgd,
+    fused_mix_update,
     fused_sgd_momentum,
     fused_sgd_momentum_tree,
+    mix_sgd_reference,
     pallas_available,
 )
 
 __all__ = [
+    "fused_mix_sgd",
+    "fused_mix_update",
     "fused_sgd_momentum",
     "fused_sgd_momentum_tree",
+    "mix_sgd_reference",
     "pallas_available",
 ]

@@ -98,6 +98,123 @@ def fused_sgd_momentum(p, m, g, *, lr: float, mu: float,
     return untile(new_p), untile(new_m)
 
 
+# ---------------------------------------------------------------------
+# Fused mix + update: the gossip epilogue in one HBM pass
+# ---------------------------------------------------------------------
+# The D-PSGD-style gossip epilogue
+#
+#     p ← mix(p) − lr·buf      (mix = the [n, n] consensus contraction)
+#
+# reads two model-sized arrays and writes one with the only FLOPs being
+# the tiny [n, n] contraction over the worker axis — like the SGD
+# update above, it is pure HBM bandwidth, but XLA materialises the
+# mixed intermediate between the two ops (one extra full write + read
+# of |θ|).  This kernel fuses both into ONE pass over memory on the
+# flat-bucket layout of ``dopt.parallel.collectives.UpdateShardSpec``
+# (ROADMAP "raw speed" lever 3, the follow-on this file's header
+# names): each [n, Fb] bucket slab is gridded over its flat axis, the
+# f32 mixing matrix rides VMEM-resident across grid steps, and the MXU
+# contraction + VPU subtract write the updated slab in place
+# (``input_output_aliases``).  Numerics: matrix and accumulation in
+# f32 regardless of leaf dtype — the same contract as the scatter mix
+# path (tests/test_ops.py pins 1e-6 agreement with the jnp
+# composition).
+
+
+def _make_mix_kernel(lr: float):
+    def kernel(w_ref, p_ref, m_ref, p_out):
+        mixed = jnp.dot(w_ref[:], p_ref[:],
+                        preferred_element_type=jnp.float32)
+        p_out[:] = mixed - lr * m_ref[:]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("lr", "interpret"))
+def fused_mix_sgd(p, buf, w, *, lr: float, interpret: bool = False):
+    """Fused gossip epilogue on ONE flat bucket: ``W @ p − lr·buf``.
+
+    ``p``/``buf`` are [n, F] stacked flat slabs (any dtype), ``w`` the
+    [n, n] mixing matrix.  Returns the updated slab with p's
+    shape/dtype, computed with the matrix and accumulation in f32 (the
+    scatter-path numerics contract) in a single memory pass.
+    """
+    n, f = p.shape
+    shape, dtype = p.shape, p.dtype
+    n_pad = -(-n // _SUBLANE) * _SUBLANE
+    # Column-block size: bound the three (n_pad, BF) f32 slabs to ~2 MiB
+    # each in VMEM (the [n_pad, n_pad] matrix block is tiny beside
+    # them), with the lane-multiple floor.
+    bf = max((1 << 19) // max(n_pad, 1) // _LANE, 1) * _LANE
+    f_pad = -(-f // bf) * bf
+    grid = f_pad // bf
+
+    def tile(x):
+        x = x.astype(jnp.float32)
+        return jnp.pad(x, ((0, n_pad - n), (0, f_pad - f)))
+
+    w_t = jnp.pad(jnp.asarray(w, jnp.float32),
+                  ((0, n_pad - n), (0, n_pad - n)))
+    pt, mt = tile(p), tile(buf)
+    w_spec = pl.BlockSpec((n_pad, n_pad), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    spec = pl.BlockSpec((n_pad, bf), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _make_mix_kernel(float(lr)),
+        out_shape=jax.ShapeDtypeStruct(pt.shape, jnp.float32),
+        grid=(grid,),
+        in_specs=[w_spec, spec, spec],
+        out_specs=spec,
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(w_t, pt, mt)
+    return out[:n, :f].reshape(shape).astype(dtype)
+
+
+def fused_mix_update(params, momentum, w_matrix, spec, *, lr: float,
+                     interpret: bool | None = None):
+    """The tree-level fused mix+update epilogue: flatten the stacked
+    [W, ...] ``params``/``momentum`` trees into ``spec``'s buckets
+    (``dopt.parallel.collectives.stacked_to_buckets``), run the fused
+    ``W @ p − lr·buf`` kernel per bucket, and restore the tree.  The
+    single-pass form of the D-PSGD round epilogue ``x ← Wx − lr·v`` on
+    the same flat-bucket substrate the scatter hot path uses.  Engine
+    wiring is the follow-on: the faithful round order (consensus →
+    eval → local update) means fusing the mix with the previous
+    round's displacement needs the scan carry restructured, which must
+    land without perturbing the oracle-parity trace.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpret mode
+    elsewhere (same code path, testable on CPU).
+    """
+    from dopt.parallel.collectives import (buckets_to_stacked,
+                                           stacked_to_buckets)
+
+    if interpret is None:
+        interpret = not pallas_available()
+    w = jnp.asarray(w_matrix, jnp.float32)
+    pb = stacked_to_buckets(params, spec)
+    mb = stacked_to_buckets(momentum, spec)
+    with jax.named_scope("dopt_update"):
+        out = [fused_mix_sgd(p, m, w, lr=float(lr), interpret=interpret)
+               for p, m in zip(pb, mb)]
+    return buckets_to_stacked(out, spec)
+
+
+def mix_sgd_reference(params, momentum, w_matrix, *, lr: float):
+    """Pure-jnp reference for ``fused_mix_update`` (same f32 matrix +
+    accumulation; XLA materialises the mixed intermediate): the parity
+    oracle the kernel is tested against."""
+    w = jnp.asarray(w_matrix, jnp.float32)
+
+    def leaf(p, m):
+        mixed = jnp.tensordot(w, p.astype(jnp.float32), axes=[[1], [0]])
+        return (mixed - lr * m.astype(jnp.float32)).astype(p.dtype)
+
+    return jax.tree.map(leaf, params, momentum)
+
+
 def fused_sgd_momentum_tree(params, momentum, grads, *, lr: float, mu: float,
                             interpret: bool | None = None):
     """Tree-map the fused kernel over a params pytree.

@@ -218,3 +218,19 @@ def _maybe_num(v: str) -> Any:
         return int(f) if f.is_integer() and "." not in v else f
     except (TypeError, ValueError):
         return v
+
+
+def trimmed_stats(values) -> tuple[float, float, list[float]]:
+    """Outlier-hardened reduction of per-window throughput samples
+    (shared by bench.py and scripts/bench_seqlm.py): with >= 4 samples
+    the min and max are DISCARDED (tunneled chips throw occasional
+    multi-second stalls that poison a plain max−min spread), then
+    (median, spread_pct, kept) over the survivors; spread_pct =
+    (max−min)/median·100 of the kept set."""
+    import statistics
+
+    vals = sorted(float(v) for v in values)
+    kept = vals[1:-1] if len(vals) >= 4 else vals
+    med = statistics.median(kept)
+    spread = 100.0 * (kept[-1] - kept[0]) / med if med > 0 else 0.0
+    return med, spread, kept

@@ -37,6 +37,7 @@ def run_point(args) -> int:
 
     from dopt.engine import SeqLMTrainer
     from dopt.presets import get_preset
+    from dopt.utils.metrics import trimmed_stats
 
     cfg = get_preset("seqlm")
     cfg = cfg.replace(seqlm=dataclasses.replace(
@@ -45,15 +46,31 @@ def run_point(args) -> int:
         log_every=max(args.steps // 3, 1)))
     tr = SeqLMTrainer(cfg)
     tr.run(steps=3)                       # compile + warmup
-    t0 = time.time()
-    tr.run(steps=args.steps)
-    jax.block_until_ready(tr.params)
-    elapsed = time.time() - t0
     tokens = args.steps * args.batch * args.seq_len
+    tps = []
+    total = 0.0
+    for _ in range(max(args.repeats, 1)):
+        t0 = time.time()
+        tr.run(steps=args.steps)
+        jax.block_until_ready(tr.params)
+        elapsed = time.time() - t0
+        total += elapsed
+        tps.append(tokens / elapsed)
+    med, spread, _ = trimmed_stats(tps)
+    # Standard bench JSON-line schema (metric/value/unit/device_kind +
+    # the trimmed-median wall reduction), so the ring-attention LM line
+    # drops into the same tooling as bench.py's headline lines
+    # (ROADMAP lever 4 groundwork: the seqlm workload as a first-class
+    # headline bench).
     out = {
         "metric": "seqlm_tokens_per_sec",
-        "value": round(tokens / elapsed, 1),
+        "value": round(med, 1),
         "unit": "tokens/sec",
+        "device_kind": str(jax.devices()[0].device_kind),
+        "spread_pct": round(spread, 2),
+        "measured_windows": len(tps),
+        "measured_seconds": round(total, 2),
+        "steps_per_window": args.steps,
         "attn": args.attn,
         "seq_len": args.seq_len,
         "batch": args.batch,
@@ -61,6 +78,7 @@ def run_point(args) -> int:
         "mesh_devices": tr.mesh.size,
         "params": tr.param_count,
         "final_loss": round(tr.history.last()["loss"], 4),
+        # Back-compat alias for pre-schema consumers of this script.
         "device": str(jax.devices()[0].device_kind),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
@@ -134,6 +152,11 @@ def run_sweep(args) -> int:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="independent measured windows; the reported "
+                         "value is their min/max-trimmed median "
+                         "(dopt.utils.metrics.trimmed_stats, the same "
+                         "variance hardening bench.py uses)")
     ap.add_argument("--seq-len", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--attn", default="ring", choices=["ring", "ulysses"])

@@ -79,7 +79,9 @@ def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig]:
     return gossip, fed
 
 
-def build_cfg(engine: str, seed: int, rounds: int) -> ExperimentConfig:
+def build_cfg(engine: str, seed: int, rounds: int,
+              prefetch: bool = False) -> ExperimentConfig:
+    pf = "on" if prefetch else "off"
     gossip_fc, fed_fc = cocktail(seed)
     if engine == "gossip":
         return ExperimentConfig(
@@ -88,21 +90,23 @@ def build_cfg(engine: str, seed: int, rounds: int) -> ExperimentConfig:
             gossip=GossipConfig(algorithm="dsgd", topology="circle",
                                 mode="metropolis", rounds=rounds,
                                 local_ep=1, local_bs=32,
-                                correction="push_sum"),
+                                correction="push_sum", prefetch=pf),
             faults=gossip_fc)
     return ExperimentConfig(
         name=f"chaos-fed-{seed}", seed=100 + seed, data=_DATA,
         model=_MODEL, optim=_OPTIM,
         federated=FederatedConfig(algorithm="fedavg", frac=0.5,
                                   rounds=rounds, local_ep=1, local_bs=32,
-                                  staleness_max=3, staleness_decay=0.5),
+                                  staleness_max=3, staleness_decay=0.5,
+                                  prefetch=pf),
         faults=fed_fc)
 
 
-def build_trainer(engine: str, seed: int, rounds: int):
+def build_trainer(engine: str, seed: int, rounds: int,
+                  prefetch: bool = False):
     from dopt.engine import FederatedTrainer, GossipTrainer
 
-    cfg = build_cfg(engine, seed, rounds)
+    cfg = build_cfg(engine, seed, rounds, prefetch=prefetch)
     return (GossipTrainer(cfg) if engine == "gossip"
             else FederatedTrainer(cfg))
 
@@ -135,7 +139,8 @@ def check_convergence(history, tol: float) -> tuple[float, float]:
 
 
 def soak_one(engine: str, seed: int, rounds: int, tol: float,
-             ckpt_dir: str, kill: bool, metrics_sink=None) -> None:
+             ckpt_dir: str, kill: bool, metrics_sink=None,
+             prefetch: bool = False) -> None:
     from dopt.obs import (JsonlSink, MemorySink, Telemetry, attach,
                           canonical, check_stream)
 
@@ -174,7 +179,11 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     # and order — at chaos-cocktail settings.  This is the degraded
     # path the throughput work fused; bit-identity is what makes the
     # speedup free.
-    blk = build_trainer(engine, seed, rounds)
+    # With --prefetch, the blocked trainer runs the staged host
+    # pipeline (dispatch → stage-next → fetch): the assertion then pins
+    # prefetched-blocked against unprefetched-per-round — the full
+    # bit-identity claim of the overlap work.
+    blk = build_trainer(engine, seed, rounds, prefetch=prefetch)
     mem_b = MemorySink()
     attach(blk, Telemetry([mem_b]), fresh=True)
     hb = blk.run(rounds=rounds, block=max(rounds // 2, 2))
@@ -185,7 +194,8 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     assert canonical(mem_b.events) == canonical(mem.events), \
         f"blocked telemetry stream diverged from per-round ({engine})"
     print(f"[{engine}] fused-block execution bit-identical ok "
-          "(History + ledger + event stream)")
+          f"(History + ledger + event stream"
+          f"{', prefetch armed' if prefetch else ''})")
 
     # Kill-and-resume bit-identity, including the telemetry stream's
     # monotonic round watermark: the resumed run APPENDS to the dead
@@ -286,6 +296,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--kill", action="store_true",
                     help="kill-and-resume via a real SIGKILLed subprocess "
                          "instead of the in-process stop")
+    ap.add_argument("--prefetch", action="store_true",
+                    help="arm the prefetched host pipeline "
+                         "(GossipConfig/FederatedConfig.prefetch='on') "
+                         "on the blocked-parity trainer, so the soak's "
+                         "bit-identity invariant exercises the staged "
+                         "dispatch → stage-next → fetch path against "
+                         "the unprefetched per-round trace")
     ap.add_argument("--ckpt-dir", default=None,
                     help="checkpoint scratch dir (default: a temp dir)")
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -315,7 +332,8 @@ def main(argv: list[str] | None = None) -> int:
         ckpt_dir = args.ckpt_dir or tmp
         for engine in engines:
             soak_one(engine, args.seed, args.rounds, args.tol, ckpt_dir,
-                     args.kill, metrics_sink=metrics_sink)
+                     args.kill, metrics_sink=metrics_sink,
+                     prefetch=args.prefetch)
     if metrics_sink is not None:
         metrics_sink.close()
         print(f"wrote telemetry stream to {args.metrics_out}")

@@ -109,3 +109,70 @@ def test_engine_with_fused_update(devices):
     fa = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(a.params))])
     fb = np.concatenate([np.ravel(x) for x in jax.tree.leaves(jax.device_get(b.params))])
     np.testing.assert_allclose(fa, fb, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Fused mix + update (the gossip epilogue, ROADMAP raw-speed lever 3)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,f", [(6, 137), (5, 1000), (8, 128), (3, 1)])
+def test_fused_mix_sgd_matches_reference(n, f, devices):
+    # One HBM pass of W @ p − lr·buf on a flat bucket must agree with
+    # the jnp composition (f32 matrix + accumulation — the scatter-path
+    # numerics contract) to reassociation tolerance.
+    from dopt.ops import fused_mix_sgd
+
+    rng = np.random.default_rng(3)
+    p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+    m = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+    w = jnp.asarray(rng.dirichlet(np.ones(n), size=n).astype(np.float32))
+    got = fused_mix_sgd(p, m, w, lr=0.05, interpret=True)
+    want = (jnp.tensordot(w, p, axes=[[1], [0]]) - 0.05 * m)
+    assert got.shape == p.shape and got.dtype == p.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_mix_sgd_bf16_storage(devices):
+    # bf16 leaf storage: matrix + accumulation stay f32, only the final
+    # store rounds — same contract as mix_dense_scatter.
+    from dopt.ops import fused_mix_sgd
+
+    rng = np.random.default_rng(4)
+    p32 = rng.normal(size=(4, 300)).astype(np.float32)
+    m32 = rng.normal(size=(4, 300)).astype(np.float32)
+    p = jnp.asarray(p32).astype(jnp.bfloat16)
+    m = jnp.asarray(m32).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.dirichlet(np.ones(4), size=4).astype(np.float32))
+    got = fused_mix_sgd(p, m, w, lr=0.1, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = (jnp.tensordot(w, p.astype(jnp.float32), axes=[[1], [0]])
+            - 0.1 * m.astype(jnp.float32)).astype(jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_fused_mix_update_tree_over_buckets(devices):
+    # The engine-facing wrapper rides the UpdateShardSpec flat-bucket
+    # layout: multi-bucket round trip, identical to the tree-level jnp
+    # reference.
+    from dopt.ops import fused_mix_update, mix_sgd_reference
+    from dopt.parallel.collectives import make_update_shard_spec
+
+    rng = np.random.default_rng(5)
+    tree = {"a": jnp.asarray(rng.normal(size=(6, 33)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(6, 5, 7)).astype(np.float32))}
+    mom = jax.tree.map(
+        lambda x: jnp.asarray(
+            rng.normal(size=x.shape).astype(np.float32)), tree)
+    spec = make_update_shard_spec(tree, fold=2, bucket_bytes=64)
+    assert spec.num_buckets > 1  # exercise the per-bucket loop
+    w = rng.dirichlet(np.ones(6), size=6).astype(np.float32)
+    got = fused_mix_update(tree, mom, w, spec, lr=0.1, interpret=True)
+    want = mix_sgd_reference(tree, mom, w, lr=0.1)
+    assert jax.tree.structure(got) == jax.tree.structure(tree)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)

@@ -1,0 +1,343 @@
+"""Prefetched host pipeline: staged execution is bit-identical.
+
+The blocked run loops can overlap the host pipeline with device compute
+(``prefetch='on'``: dispatch → stage-next → fetch, block b+1's batch
+plans built + staged while block b runs — ``dopt/data/prefetch.py``).
+The contract these tests pin: prefetch-on runs are BIT-IDENTICAL to
+prefetch-off — History rows, fault-ledger rows (content AND order), the
+canonical telemetry stream, and the final device state — on chaos
+cocktails for BOTH engines, including kill-and-resume mid-stream with
+prefetch armed (staging never crosses a checkpoint boundary).
+
+Also here: the vectorized ``make_batch_plan`` byte-identity contract
+(the (seed, round, ep, wid) SeedSequence keys survive the batched-numpy
+rewrite) and the ``PrefetchStager`` queue semantics.
+
+Tier-1-lean per the house budget (mlp model, tiny synthetic data, one
+cocktail per engine); the wider sweeps are ``slow``.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         FederatedConfig, GossipConfig, ModelConfig,
+                         OptimizerConfig, PopulationConfig, RobustConfig)
+
+_DATA = DataConfig(dataset="synthetic", num_users=6, iid=True,
+                   synthetic_train_size=192, synthetic_test_size=64)
+_FDATA = dataclasses.replace(_DATA, num_users=8, synthetic_train_size=256)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
+
+
+def _gossip_cfg(prefetch, faults=None, robust=None, population=None,
+                **gkw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=32, prefetch=prefetch)
+    g.update(gkw)
+    return ExperimentConfig(name="t", seed=7, data=_DATA, model=_MODEL,
+                            optim=_OPTIM, gossip=GossipConfig(**g),
+                            faults=faults, robust=robust,
+                            population=population)
+
+
+def _fed_cfg(prefetch, faults=None, robust=None, population=None, **fkw):
+    f = dict(algorithm="fedavg", frac=0.5, rounds=4, local_ep=1,
+             local_bs=32, prefetch=prefetch)
+    f.update(fkw)
+    return ExperimentConfig(name="t", seed=7, data=_FDATA, model=_MODEL,
+                            optim=_OPTIM, federated=FederatedConfig(**f),
+                            faults=faults, robust=robust,
+                            population=population)
+
+
+def _run_streamed(trainer, rounds, block):
+    """run() with a MemorySink attached; returns (history, events)."""
+    from dopt.obs import MemorySink, Telemetry, attach
+
+    mem = MemorySink()
+    attach(trainer, Telemetry([mem]), fresh=True)
+    h = trainer.run(rounds=rounds, block=block)
+    return h, mem.events
+
+
+def _assert_identical(ta, ha, ea, tb, hb, eb, what, state="params"):
+    import jax
+
+    from dopt.obs import canonical
+
+    assert ha.rows == hb.rows, f"{what}: history diverged"
+    assert ha.faults == hb.faults, f"{what}: ledger diverged"
+    assert canonical(ea) == canonical(eb), \
+        f"{what}: canonical telemetry stream diverged"
+    for la, lb in zip(jax.tree.leaves(jax.device_get(getattr(ta, state))),
+                      jax.tree.leaves(jax.device_get(getattr(tb, state)))):
+        np.testing.assert_array_equal(la, lb, err_msg=f"{what}: {state}")
+
+
+# ---------------------------------------------------------------------------
+# PrefetchStager unit semantics (tier-1, no engine builds)
+# ---------------------------------------------------------------------------
+
+def test_stager_stage_take_discard():
+    from dopt.data import PrefetchStager
+
+    st = PrefetchStager()
+    st.stage(3, lambda m: {"built": m["x"] * 2}, {"x": 21})
+    assert len(st) == 1
+    assert st.take(3) == {"built": 42}
+    assert len(st) == 0
+    # A take of an un-staged key is a miss (caller builds inline) and
+    # flushes any stale pending payloads.
+    st.stage(4, lambda m: m, {"x": 1})
+    assert st.take(9) is None
+    assert len(st) == 0
+    # Bounded depth: one staged successor at most.
+    st.stage(5, lambda m: m, {})
+    with pytest.raises(RuntimeError):
+        st.stage(6, lambda m: m, {})
+    st.discard()
+    assert len(st) == 0
+
+
+def test_stager_build_errors_surface_at_take():
+    from dopt.data import PrefetchStager
+
+    def boom(meta):
+        raise ValueError("staged build failed")
+
+    st = PrefetchStager()
+    st.stage(0, boom, {})
+    with pytest.raises(ValueError, match="staged build failed"):
+        st.take(0)
+    # ... but a DISCARDED failed build is not an error (its payload was
+    # never going to be used).
+    st.stage(1, boom, {})
+    st.discard()
+
+
+def test_stager_rejects_degenerate_depth():
+    from dopt.data import PrefetchStager
+
+    with pytest.raises(ValueError):
+        PrefetchStager(depth=1)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized make_batch_plan: byte-identity with the per-worker loop
+# ---------------------------------------------------------------------------
+
+def _reference_plan(index_matrix, *, batch_size, local_ep, seed, round_idx,
+                    drop_last, worker_ids):
+    """The pre-vectorization per-worker/per-epoch loop, verbatim — the
+    (seed, round, ep, wid) SeedSequence keys are the contract."""
+    w, l = index_matrix.shape
+    bs = min(batch_size, l)
+    steps_per_epoch = l // bs if drop_last else -(-l // bs)
+    padded = steps_per_epoch * bs
+    s = local_ep * steps_per_epoch
+    idx = np.empty((w, s, bs), dtype=np.int32)
+    weight = np.empty((w, s, bs), dtype=np.float32)
+    for wi in range(w):
+        wid = int(worker_ids[wi]) if worker_ids is not None else wi
+        rows_i, mask_i = [], []
+        for ep in range(local_ep):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, round_idx, ep, wid]))
+            perm = rng.permutation(l)
+            if drop_last:
+                perm = perm[:padded]
+                mask = np.ones(padded, np.float32)
+            else:
+                pad = padded - l
+                mask = np.concatenate([np.ones(l, np.float32),
+                                       np.zeros(pad, np.float32)])
+                perm = np.concatenate([perm, perm[:pad]]) if pad else perm
+            rows_i.append(index_matrix[wi][perm].reshape(steps_per_epoch,
+                                                         bs))
+            mask_i.append(mask.reshape(steps_per_epoch, bs))
+        idx[wi] = np.concatenate(rows_i, axis=0)
+        weight[wi] = np.concatenate(mask_i, axis=0)
+    return idx, weight
+
+
+@pytest.mark.parametrize("w,l,bs,ep,drop", [
+    (6, 37, 8, 3, False),    # wraparound padding, multi-epoch
+    (4, 40, 8, 2, True),     # drop_last
+    (8, 33, 64, 1, False),   # bs > shard (bs clamp) — zero padding
+    (3, 10, 3, 2, False),
+])
+def test_make_batch_plan_vectorized_byte_identity(w, l, bs, ep, drop):
+    from dopt.data import make_batch_plan
+
+    rng = np.random.default_rng(11)
+    im = rng.integers(0, 997, size=(w, l)).astype(np.int64)
+    for kw, wids in (({}, None),
+                     ({"workers": np.array([2, 0])}, np.array([2, 0])),
+                     ({"workers": np.array([1, 2]),
+                       "rows": np.array([0, 0])}, np.array([1, 2]))):
+        plan = make_batch_plan(im, batch_size=bs, local_ep=ep, seed=5,
+                               round_idx=7, drop_last=drop, **kw)
+        sel = (np.asarray(kw["rows"]) if "rows" in kw
+               else wids if wids is not None
+               else np.arange(w))
+        ri, rw = _reference_plan(im[sel], batch_size=bs, local_ep=ep,
+                                 seed=5, round_idx=7, drop_last=drop,
+                                 worker_ids=wids)
+        assert plan.idx.dtype == np.int32
+        assert plan.weight.dtype == np.float32
+        np.testing.assert_array_equal(plan.idx, ri)
+        np.testing.assert_array_equal(plan.weight, rw)
+
+
+# ---------------------------------------------------------------------------
+# Prefetch-on vs prefetch-off bit-identity (engine builds — one lean
+# cocktail per engine tier-1, the wider matrix slow)
+# ---------------------------------------------------------------------------
+
+def test_gossip_prefetch_chaos_bit_identity_and_resume(tmp_path, devices):
+    # Fused-quarantine cocktail (crash + straggle + Byzantine scale-lies
+    # + quarantine) on the blocked scan: staged execution must replay
+    # the unstaged trace bit-for-bit, and a run checkpointed mid-stream
+    # and resumed WITH prefetch armed must match the continuous
+    # unprefetched run (the discard-at-checkpoint rule).
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(crash=0.15, straggle=0.3, straggle_frac=0.5,
+                     corrupt=0.25, corrupt_mode="scale", corrupt_scale=8.0)
+    rc = RobustConfig(quarantine_after=1, quarantine_rounds=2)
+
+    # Every run uses block=2 so all four trainers compile ONE block
+    # shape (tier-1 budget: compiles dominate these tests).
+    off = GossipTrainer(_gossip_cfg("off", fc, rc))
+    h_off, e_off = _run_streamed(off, rounds=4, block=2)
+    on = GossipTrainer(_gossip_cfg("on", fc, rc))
+    h_on, e_on = _run_streamed(on, rounds=4, block=2)
+    _assert_identical(off, h_off, e_off, on, h_on, e_on,
+                      "gossip chaos prefetch")
+
+    path = tmp_path / "gossip-ckpt"
+    part = GossipTrainer(_gossip_cfg("on", fc, rc))
+    part.run(rounds=2, block=2, checkpoint_every=2, checkpoint_path=path)
+    res = GossipTrainer(_gossip_cfg("on", fc, rc))
+    res.restore(path)
+    assert res.round == 2
+    hk = res.run(rounds=2, block=2)
+    assert hk.rows == h_off.rows, "gossip resume: history diverged"
+    assert hk.faults == h_off.faults, "gossip resume: ledger diverged"
+
+
+def test_federated_prefetch_chaos_bit_identity(devices):
+    # Staleness + quarantine + nan-liar cocktail through the fused
+    # chaos scan: the staged participation draws must advance the
+    # sampling stream at identical positions, and the post-fetch replay
+    # (which never re-draws) must regenerate the identical ledger.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(crash=0.1, straggle=0.5, straggle_frac=0.5,
+                     straggler_policy="drop", over_select=0.3,
+                     corrupt=0.2, corrupt_mode="nan",
+                     msg_drop=0.1, msg_delay=0.2, msg_delay_max=2)
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+
+    off = FederatedTrainer(_fed_cfg("off", fc, rc, staleness_max=2))
+    h_off, e_off = _run_streamed(off, rounds=4, block=2)
+    on = FederatedTrainer(_fed_cfg("on", fc, rc, staleness_max=2))
+    h_on, e_on = _run_streamed(on, rounds=4, block=2)
+    _assert_identical(off, h_off, e_off, on, h_on, e_on,
+                      "federated chaos prefetch", state="theta")
+
+
+def test_prefetch_rejections(devices):
+    # Gossip population mode stages registry mutations at plan time;
+    # federated population quarantine needs post-fetch feedback for
+    # eligibility — both reject prefetch loudly at construction, and
+    # unknown knob values fail like every other config enum.
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    with pytest.raises(ValueError, match="off\\|on"):
+        GossipTrainer(_gossip_cfg("maybe"))
+    with pytest.raises(ValueError, match="population"):
+        GossipTrainer(_gossip_cfg(
+            "on", population=PopulationConfig(clients=12, cohort=6)))
+    with pytest.raises(ValueError, match="quarantine"):
+        FederatedTrainer(_fed_cfg(
+            "on", faults=FaultConfig(corrupt=0.2, corrupt_mode="nan"),
+            robust=RobustConfig(quarantine_after=2, quarantine_rounds=3),
+            population=PopulationConfig(clients=16, cohort=8)))
+
+
+# ---------------------------------------------------------------------------
+# Wider sweeps (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gossip_prefetch_link_mode_bit_identity(devices):
+    # Link-mode blocked path: per-staleness matrix stacks + push-sum
+    # mass/buffers as carry; the staged draw runs the link-fault ledger
+    # rows at plan time, in block order.
+    from dopt.engine import GossipTrainer
+
+    fc = FaultConfig(msg_drop=0.2, msg_delay=0.3, msg_delay_max=2,
+                     crash=0.1, churn=0.05, churn_span=2)
+    off = GossipTrainer(_gossip_cfg("off", fc, correction="push_sum"))
+    h_off, e_off = _run_streamed(off, rounds=6, block=3)
+    on = GossipTrainer(_gossip_cfg("on", fc, correction="push_sum"))
+    h_on, e_on = _run_streamed(on, rounds=6, block=3)
+    _assert_identical(off, h_off, e_off, on, h_on, e_on,
+                      "gossip link prefetch")
+
+
+@pytest.mark.slow
+def test_federated_population_prefetch_bit_identity(devices):
+    # Population waves (no client quarantine — the prefetch-eligible
+    # regime): the cohort draw is stateless per round and participation
+    # commits post-fetch, so the staged path replays the registry
+    # gauges and cohort ledger rows identically.
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(crash=0.1, corrupt=0.1, corrupt_mode="nan",
+                     churn=0.05, churn_span=2)
+    pop = PopulationConfig(clients=48, cohort=16)
+    off = FederatedTrainer(_fed_cfg("off", fc, population=pop))
+    h_off, e_off = _run_streamed(off, rounds=5, block=1)
+    on = FederatedTrainer(_fed_cfg("on", fc, population=pop))
+    h_on, e_on = _run_streamed(on, rounds=5, block=1)
+    _assert_identical(off, h_off, e_off, on, h_on, e_on,
+                      "population prefetch", state="theta")
+
+
+@pytest.mark.slow
+def test_federated_prefetch_kill_and_resume(tmp_path, devices):
+    # Chaos-blocked federated resume with prefetch armed on every
+    # segment: the checkpointed sampling-RNG state must sit exactly at
+    # the committed boundary (nothing staged past it).
+    from dopt.engine import FederatedTrainer
+
+    fc = FaultConfig(straggle=0.5, straggle_frac=0.5,
+                     straggler_policy="drop", corrupt=0.3,
+                     corrupt_mode="nan", msg_delay=0.2, msg_delay_max=2)
+    rc = RobustConfig(quarantine_after=2, quarantine_rounds=3)
+
+    def make(pf):
+        return FederatedTrainer(_fed_cfg(pf, fc, rc, staleness_max=2))
+
+    cont = make("off")
+    hc = cont.run(rounds=8, block=2)
+    path = tmp_path / "fed-ckpt"
+    part = make("on")
+    # checkpoint_every (4) > block (2): the staged path runs WITH an
+    # intervening checkpoint schedule — block [2,3] is staged during
+    # block [0,1], but nothing is staged past round 4's checkpoint, so
+    # the kill after round 6 resumes from a commit point whose RNG
+    # state saw exactly rounds 0..3.
+    part.run(rounds=6, block=2, checkpoint_every=4, checkpoint_path=path)
+    res = make("on")
+    res.restore(path)
+    assert res.round == 4
+    hr = res.run(rounds=4, block=2)
+    assert hr.rows == hc.rows
+    assert hr.faults == hc.faults
