@@ -545,6 +545,17 @@ def main() -> None:
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write a Chrome-trace JSON of the measured "
                          "blocks' host spans here (dopt.obs span tracer)")
+    ap.add_argument("--history-out", default="results/bench_history.jsonl",
+                    metavar="PATH",
+                    help="append the headline JSON line (stamped with "
+                         "git sha + run id) to this perf-regression "
+                         "ledger (dopt.obs.regress; compare runs with "
+                         "'python -m dopt.obs.regress PATH'); '' "
+                         "disables.  --quick and --smoke runs never "
+                         "append (tiny-data values would poison the "
+                         "trailing medians) — CI judges the quick "
+                         "artifact via 'dopt.obs.regress --candidate' "
+                         "instead")
     ap.add_argument("--idiomatic", action="store_true",
                     help="benchmark the idiomatic model head (post-conv "
                          "ReLUs, logit head + softmax-CE — faithful=False) "
@@ -745,6 +756,20 @@ def main() -> None:
           f"{fast['spread_pct']:.1f}%; acc={fast['avg_test_acc']:.4f}, "
           f"{fast_sps:,.0f} samples/s)", file=sys.stderr)
     print(json.dumps(result))
+    if args.history_out and not args.smoke:
+        # The bench trajectory as a ledger: one entry per real run, so
+        # the NEXT run can be judged against the trailing trimmed
+        # median (dopt.obs.regress).  Never fatal — a read-only
+        # checkout still benches.
+        try:
+            from dopt.obs.regress import append_entry
+
+            entry = append_entry(args.history_out, result)
+            print(f"# appended run {entry['run_id']} "
+                  f"(sha {entry['git_sha'] or 'unknown'}) to "
+                  f"{args.history_out}", file=sys.stderr)
+        except OSError as e:
+            print(f"# bench history append failed: {e}", file=sys.stderr)
     _finish_telemetry(result)
 
 

@@ -2327,15 +2327,23 @@ class FederatedTrainer:
         tele = self.telemetry
         if tele is None:
             return
+        quarantined = int((self._quarantine_until > t).sum())
         gauges = {
-            "quarantine_active": float((self._quarantine_until > t).sum()),
+            "quarantine_active": float(quarantined),
             "screen_streak_max": float(self._screen_streak.max()),
+            # Denominator gauge for the monitor's fleet-fraction rules
+            # (dopt.obs.rules): lanes eligible to contribute this round.
+            "participating_lanes": float(self.num_workers - quarantined),
         }
         if self._has_stale:
             gauges["stale_pending"] = float((self._stale_weight > 0).sum())
             gauges["stale_weight_total"] = float(self._stale_weight.sum())
         if self._registry is not None:
             reg = self._registry
+            gauges["cohort_size"] = float(reg.cohort_size)
+            # Denominator for the monitor's client-keyed quarantine
+            # storm (population_quarantined / population_size).
+            gauges["population_size"] = float(reg.clients)
             gauges["population_quarantined"] = float(
                 (reg.quarantine_until > t).sum())
             gauges["population_sampled_total"] = float(
@@ -2344,24 +2352,32 @@ class FederatedTrainer:
                                metrics=self.history.rows[-1],
                                faults=frows, gauges=gauges)
 
-    def _run_summary_telemetry(self) -> None:
-        """End-of-``run()`` consensus-distance gauge: mean over workers
-        of ‖pᵢ − theta‖₂ from the final device state — one fetch per
-        run() call, so per-round and blocked execution of the same call
-        pattern emit the identical event.  Population mode skips it
-        (clients are stateless; the stacked lane params are not client
-        state)."""
-        tele = self.telemetry
-        if tele is None or self.round == 0 or self._registry is not None:
-            return
+    def _consensus_value(self) -> float | None:
+        """Mean over workers of ‖pᵢ − theta‖₂ from the current device
+        state, or None when there is nothing to report (round 0,
+        population mode — clients are stateless, the stacked lane
+        params are not client state — or a diverged fleet)."""
+        if self.round == 0 or self._registry is not None:
+            return None
         import math
 
         from dopt.obs import consensus_distance
 
         cd = consensus_distance(self.params, self.theta)
-        if math.isfinite(cd):  # a diverged fleet has no distance to report
+        return cd if math.isfinite(cd) else None
+
+    def _run_summary_telemetry(self) -> None:
+        """End-of-``run()`` consensus-distance gauge — one fetch per
+        run() call, so per-round and blocked execution of the same call
+        pattern emit the identical event."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        cd = self._consensus_value()
+        if cd is not None:
             tele.emit("gauge", round=self.round - 1,
-                      name="consensus_distance", value=cd)
+                      name="consensus_distance", value=cd,
+                      engine=self.engine_kind)
 
     def save(self, path) -> None:
         """Checkpoint (theta, stacked params, momentum, duals, round,
@@ -2370,6 +2386,21 @@ class FederatedTrainer:
         — without it, round t after resume replays round 0's sample."""
         with self.timers.phase("checkpoint"):
             self._save(path)
+        if self.telemetry is not None:
+            # Cadence telemetry for the monitor's checkpoint-cadence
+            # rule (dopt.obs.rules) — emitted AFTER the atomic save
+            # landed, so the stream never claims a checkpoint a kill
+            # could have torn.  The consensus snapshot rides the
+            # checkpoint event (params are being fetched for
+            # serialization anyway), NOT a gauge: checkpoint timing is
+            # call-pattern state, and gauges must stay identical across
+            # execution paths (ConsensusStallRule(use_checkpoints=True)
+            # opts in).
+            ev = {"round": int(self.round)}
+            cd = self._consensus_value()
+            if cd is not None:
+                ev["consensus_distance"] = cd
+            self.telemetry.emit("checkpoint", **ev)
 
     def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint

@@ -1422,12 +1422,20 @@ class GossipTrainer:
         tele = self.telemetry
         if tele is None:
             return
+        quarantined = int((self._quarantine_until > t).sum())
         gauges = {
-            "quarantine_active": float((self._quarantine_until > t).sum()),
+            "quarantine_active": float(quarantined),
             "screen_streak_max": float(self._screen_streak.max()),
+            # Denominator gauge for the monitor's fleet-fraction rules
+            # (dopt.obs.rules): lanes eligible to contribute this round.
+            "participating_lanes": float(self.num_workers - quarantined),
         }
         if self._registry is not None:
             reg = self._registry
+            gauges["cohort_size"] = float(reg.cohort_size)
+            # Denominator for the monitor's client-keyed quarantine
+            # storm (population_quarantined / population_size).
+            gauges["population_size"] = float(reg.clients)
             gauges["population_quarantined"] = float(
                 (reg.quarantine_until > t).sum())
             gauges["population_sampled_total"] = float(
@@ -1436,23 +1444,32 @@ class GossipTrainer:
                                metrics=self.history.rows[-1],
                                faults=frows, gauges=gauges)
 
-    def _run_summary_telemetry(self) -> None:
-        """End-of-``run()`` consensus-distance gauge: mean over workers
-        of ‖xᵢ − x̄‖₂ on the de-biased estimates (push-sum runs measure
-        the ratio estimates — the quantity that actually converges).
-        One fetch per run() call; identical across execution paths for
-        an identical call pattern."""
-        tele = self.telemetry
-        if tele is None or self.round == 0:
-            return
+    def _consensus_value(self) -> float | None:
+        """Mean over workers of ‖xᵢ − x̄‖₂ on the de-biased estimates
+        (push-sum runs measure the ratio estimates — the quantity that
+        actually converges), or None when there is nothing to report
+        (round 0, or a diverged fleet)."""
+        if self.round == 0:
+            return None
         import math
 
         from dopt.obs import consensus_distance
 
         cd = consensus_distance(self._debiased_params())
-        if math.isfinite(cd):  # a diverged fleet has no distance to report
+        return cd if math.isfinite(cd) else None
+
+    def _run_summary_telemetry(self) -> None:
+        """End-of-``run()`` consensus-distance gauge — one fetch per
+        run() call; identical across execution paths for an identical
+        call pattern."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        cd = self._consensus_value()
+        if cd is not None:
             tele.emit("gauge", round=self.round - 1,
-                      name="consensus_distance", value=cd)
+                      name="consensus_distance", value=cd,
+                      engine=self.engine_kind)
 
     def _matrix_for_round(self, t: int) -> np.ndarray:
         g = self.cfg.gossip
@@ -1755,6 +1772,21 @@ class GossipTrainer:
         resumed 'gossip' run must not replay round-0 matchings)."""
         with self.timers.phase("checkpoint"):
             self._save(path)
+        if self.telemetry is not None:
+            # Cadence telemetry for the monitor's checkpoint-cadence
+            # rule (dopt.obs.rules) — emitted AFTER the atomic save
+            # landed, so the stream never claims a checkpoint a kill
+            # could have torn.  The consensus snapshot rides the
+            # checkpoint event (params are being fetched for
+            # serialization anyway), NOT a gauge: checkpoint timing is
+            # call-pattern state, and gauges must stay identical across
+            # execution paths (ConsensusStallRule(use_checkpoints=True)
+            # opts in).
+            ev = {"round": int(self.round)}
+            cd = self._consensus_value()
+            if cd is not None:
+                ev["consensus_distance"] = cd
+            self.telemetry.emit("checkpoint", **ev)
 
     def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint

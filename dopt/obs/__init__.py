@@ -12,7 +12,14 @@ scripts.  This package is the one substrate:
 * host-side **span tracing** (``dopt.obs.spans``) with a Chrome-trace
   export, hooked into the engines' existing ``PhaseTimers`` sites;
 * a **sink layer** (``dopt.obs.sinks``): JSONL file, in-memory ring,
-  Prometheus text snapshot.
+  Prometheus text snapshot;
+* a **streaming health monitor** (``dopt.obs.monitor`` +
+  ``dopt.obs.rules``): a declarative rule set evaluated over the live
+  stream (in-process sink or JSONL tail), emitting ``alert`` events
+  and an end-of-run ``HealthReport`` verdict — with a scrape endpoint
+  (``python -m dopt.obs.serve``: /metrics + /healthz), a live terminal
+  tail (``python -m dopt.obs.watch``), and a bench perf-regression
+  ledger (``dopt.obs.regress`` over ``results/bench_history.jsonl``).
 
 Hard invariants:
 
@@ -46,14 +53,18 @@ from typing import Any, Iterable, Mapping
 from dopt.obs.events import (DETERMINISTIC_KINDS, KINDS, SCHEMA_VERSION,
                              canonical, check_stream, make_event,
                              sanitize_metrics, validate_event)
+from dopt.obs.monitor import HealthMonitor, HealthReport, JsonlTail
+from dopt.obs.rules import RULES, build_rules, default_rules
 from dopt.obs.sinks import JsonlSink, MemorySink, PrometheusSink, Sink
 from dopt.obs.spans import SpanTracer
 
 __all__ = [
-    "DETERMINISTIC_KINDS", "KINDS", "SCHEMA_VERSION", "JsonlSink",
+    "DETERMINISTIC_KINDS", "KINDS", "RULES", "SCHEMA_VERSION",
+    "HealthMonitor", "HealthReport", "JsonlSink", "JsonlTail",
     "MemorySink", "PrometheusSink", "Sink", "SpanTracer", "Telemetry",
-    "attach", "canonical", "check_stream", "consensus_distance",
-    "make_event", "sanitize_metrics", "validate_event",
+    "attach", "build_rules", "canonical", "check_stream",
+    "consensus_distance", "default_rules", "make_event",
+    "sanitize_metrics", "validate_event",
 ]
 
 
@@ -109,7 +120,7 @@ class Telemetry:
                              worker=int(r["worker"]), fault=str(r["kind"]),
                              action=str(r["action"])) for r in faults]
         bundle.extend(make_event("gauge", round=t, name=name,
-                                 value=float(value))
+                                 value=float(value), engine=engine)
                       for name, value in (gauges or {}).items())
         bundle.append(make_event("round", round=t, engine=engine,
                                  metrics=sanitize_metrics(metrics)))

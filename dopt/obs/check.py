@@ -4,7 +4,11 @@ Validates every event against the versioned schema (dopt.obs.events)
 and enforces the continuity invariant — within each ``run`` segment the
 round sequence is gapless and duplicate-free — then prints a one-line
 summary per file.  Exit code 1 on the first violation, so CI can gate
-on the artifact it just produced.  Stdlib-only (no jax import).
+on the artifact it just produced.  ``--summary`` additionally prints a
+per-file inventory (per-kind event counts, round span per segment,
+gauge key inventory, alert rules fired) — the eyeball view of a
+10k-round stream the pass/fail line can't give.  Stdlib-only (no jax
+import).
 """
 
 from __future__ import annotations
@@ -26,14 +30,90 @@ def check_file(path: str) -> dict[str, Any]:
     return check_stream(events)
 
 
+def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
+    """Inventory of an (already validated) stream: per-kind counts,
+    per-segment round spans, gauge keys (count + last value), round
+    metric keys, fault kinds, alert rules."""
+    kinds: dict[str, int] = {}
+    segments: list[dict[str, Any]] = []
+    gauges: dict[str, dict[str, Any]] = {}
+    metric_keys: dict[str, int] = {}
+    faults: dict[str, int] = {}
+    alerts: dict[str, int] = {}
+    for ev in events:
+        kind = ev.get("kind")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "run":
+            segments.append({"engine": ev.get("engine"),
+                             "name": ev.get("name"),
+                             "start": ev.get("round"),
+                             "first": None, "last": None, "rounds": 0})
+        elif kind == "round":
+            if not segments:
+                segments.append({"engine": ev.get("engine"),
+                                 "name": None, "start": ev.get("round"),
+                                 "first": None, "last": None, "rounds": 0})
+            seg = segments[-1]
+            t = ev.get("round")
+            seg["first"] = t if seg["first"] is None else seg["first"]
+            seg["last"] = t
+            seg["rounds"] += 1
+            for k in ev.get("metrics", {}):
+                metric_keys[k] = metric_keys.get(k, 0) + 1
+        elif kind == "gauge":
+            g = gauges.setdefault(str(ev.get("name")),
+                                  {"count": 0, "last": None})
+            g["count"] += 1
+            g["last"] = ev.get("value")
+        elif kind == "fault":
+            f = str(ev.get("fault"))
+            faults[f] = faults.get(f, 0) + 1
+        elif kind == "alert":
+            r = str(ev.get("rule"))
+            alerts[r] = alerts.get(r, 0) + 1
+    return {"kinds": kinds, "segments": segments, "gauges": gauges,
+            "metric_keys": metric_keys, "faults": faults, "alerts": alerts}
+
+
+def print_summary(path: str, inv: dict[str, Any]) -> None:
+    print(f"{path}:")
+    print("  kinds     " + "  ".join(
+        f"{k}={v}" for k, v in sorted(inv["kinds"].items())))
+    for i, seg in enumerate(inv["segments"]):
+        span = ("-" if seg["first"] is None
+                else f"{seg['first']}..{seg['last']}")
+        print(f"  segment {i}  {seg['engine'] or '?'}"
+              f"/{seg['name'] or '?'} start={seg['start']} "
+              f"rounds {span} ({seg['rounds']} events)")
+    if inv["metric_keys"]:
+        print("  metrics   " + "  ".join(
+            f"{k}({v})" for k, v in sorted(inv["metric_keys"].items())))
+    for name in sorted(inv["gauges"]):
+        g = inv["gauges"][name]
+        print(f"  gauge     {name}: {g['count']} obs, last={g['last']:g}")
+    if inv["faults"]:
+        print("  faults    " + "  ".join(
+            f"{k}={v}" for k, v in sorted(inv["faults"].items())))
+    if inv["alerts"]:
+        print("  alerts    " + "  ".join(
+            f"{k}={v}" for k, v in sorted(inv["alerts"].items())))
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="+", metavar="METRICS_JSONL")
+    ap.add_argument("--summary", action="store_true",
+                    help="print a per-file inventory (per-kind counts, "
+                         "round span per segment, gauge keys, alert "
+                         "rules) after validating")
     args = ap.parse_args(argv)
     rc = 0
     for path in args.paths:
         try:
-            s = check_file(path)
+            events = JsonlSink.read(path)
+            if not events:
+                raise ValueError(f"{path}: empty telemetry stream")
+            s = check_stream(events)
         except (OSError, ValueError) as e:
             print(f"{path}: FAIL {e}", file=sys.stderr)
             rc = 1
@@ -41,6 +121,8 @@ def main(argv: list[str] | None = None) -> int:
         kinds = " ".join(f"{k}={v}" for k, v in sorted(s["kinds"].items()))
         print(f"{path}: ok — {s['events']} events, {s['rounds']} rounds, "
               f"{s['segments']} segment(s) [{kinds}]")
+        if args.summary:
+            print_summary(path, summarize(events))
     return rc
 
 

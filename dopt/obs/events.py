@@ -26,6 +26,25 @@ a ``kind``, and a wall-clock ``ts``.  The kinds:
              through the same stream.
 ``warning``  a degraded-but-continuing condition (e.g. the xplane
              profiler reduction failed mid-bench).
+``alert``    a health-rule firing (dopt.obs.monitor): ``rule``,
+             ``severity`` (warn|critical), ``message``, optional
+             numeric ``value``, the triggering ``round``.  Derived
+             exclusively from the deterministic kinds, so the alert
+             sequence is identical across execution paths — but alerts
+             are OUTPUT, not replay data, so they stay outside
+             ``DETERMINISTIC_KINDS`` (a stream with a monitor attached
+             must stay canonically equal to one without).
+``checkpoint`` an auto-checkpoint committed at ``round`` (engines emit
+             it after the atomic save lands), optionally carrying a
+             ``consensus_distance`` snapshot (params are fetched for
+             serialization anyway).  Cadence telemetry for the
+             checkpoint-cadence and opt-in consensus-stall rules; NOT
+             deterministic — blocked execution checkpoints at block
+             boundaries.
+
+The v1 schema evolves additively: new kinds and new optional fields
+appear under the same ``v`` (consumers ignore unknown kinds/keys);
+``v`` itself bumps only if an existing field changes meaning.
 
 Deterministic kinds (``DETERMINISTIC_KINDS``) are derived exclusively
 from post-fetch host-replay data, so per-round, blocked and
@@ -45,7 +64,10 @@ from typing import Any, Iterable
 
 SCHEMA_VERSION = 1
 
-KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning")
+KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning",
+         "alert", "checkpoint")
+
+ALERT_SEVERITIES = ("warn", "critical")
 
 # Kinds whose content is a pure function of the round's host-replay
 # data: streams filtered to these (ts dropped) are bit-identical across
@@ -137,6 +159,8 @@ def validate_event(ev: Any) -> dict[str, Any]:
         v = ev.get("value")
         if not _is_num(v) or not math.isfinite(v):
             _fail("gauge event needs a finite numeric value", ev)
+        if "engine" in ev:
+            _req_str(ev, "engine")
     elif kind == "fault":
         _req_int(ev, "round")
         # worker -1 = fleet-level row (the population registry's
@@ -165,6 +189,20 @@ def validate_event(ev: Any) -> dict[str, Any]:
                 _fail(f"bench metric {k!r} must be finite", ev)
     elif kind == "warning":
         _req_str(ev, "message")
+    elif kind == "alert":
+        _req_int(ev, "round")
+        _req_str(ev, "rule")
+        _req_str(ev, "message")
+        if ev.get("severity") not in ALERT_SEVERITIES:
+            _fail(f"alert severity must be one of {ALERT_SEVERITIES}", ev)
+        if "value" in ev and not _is_num(ev["value"]):
+            _fail("alert value must be numeric", ev)
+    elif kind == "checkpoint":
+        _req_int(ev, "round")
+        if "consensus_distance" in ev:
+            v = ev["consensus_distance"]
+            if not _is_num(v) or not math.isfinite(v):
+                _fail("checkpoint consensus_distance must be finite", ev)
     return ev
 
 

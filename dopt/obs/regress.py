@@ -1,0 +1,264 @@
+"""Perf-regression ledger over the bench trajectory.
+
+``results/bench_history.jsonl`` is an append-only ledger: every
+bench.py headline JSON line lands as one entry stamped with the git sha
+and a run id (``append_entry``), so the r01→r05 trajectory the
+committed ``BENCH_r*.json`` files hold becomes data a regressor can
+watch — per run, not per postmortem.
+
+``check_regression`` compares a candidate entry against the trailing
+window of earlier entries with the same ``(metric, device_kind)`` key
+(a CPU --quick artifact never gets judged against TPU history), one
+tracked throughput/efficiency key at a time:
+
+* baseline = min/max-trimmed median of the trailing window
+  (``dopt.utils.metrics.trimmed_stats`` — the same outlier hardening
+  the bench wall measurement uses);
+* noise band = max(``min_band_pct``, half the trimmed spread): a
+  trajectory that historically wobbles ±13% does not alarm at −8%, a
+  flat one alarms past the 5% floor;
+* only ADVERSE deltas flag (throughput down, ``host_gap_pct`` up) —
+  an improvement is never a regression.
+
+CLI (stdlib-only, no jax):
+
+    python -m dopt.obs.regress results/bench_history.jsonl
+    python -m dopt.obs.regress results/bench_history.jsonl \
+        --candidate bench-quick.json --advisory
+
+Exit 1 when any tracked metric regresses (``--advisory`` reports but
+always exits 0 — the CI annotation mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+from dopt.utils.metrics import trimmed_stats
+
+LEDGER_VERSION = 1
+
+# Headline keys the regressor watches, with the adverse direction:
+# "higher" means higher is better (a drop regresses), "lower" the
+# opposite (host_gap_pct growing back means the overlap eroded).
+TRACKED_METRICS: dict[str, str] = {
+    "value": "higher",
+    "device_rounds_per_sec": "higher",
+    "samples_per_sec": "higher",
+    "model_tflops_per_sec": "higher",
+    "mfu_vs_bf16_peak": "higher",
+    "faithful_f32_rounds_per_sec": "higher",
+    "gossip_rounds_per_sec_chaos": "higher",
+    "chaos_speedup_vs_per_round": "higher",
+    "clients_per_sec_1k": "higher",
+    "clients_per_sec_10k": "higher",
+    "host_gap_pct": "lower",
+}
+
+
+def git_sha(cwd: str | Path | None = None) -> str | None:
+    """Current commit sha, or None outside a repo / without git."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, capture_output=True,
+            text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def make_entry(headline: dict[str, Any], *, run_id: str | None = None,
+               sha: str | None = None,
+               ts: float | None = None) -> dict[str, Any]:
+    """Wrap one bench headline dict into a ledger entry."""
+    if not isinstance(headline, dict) or "metric" not in headline:
+        raise ValueError(f"not a bench headline line: {headline!r}")
+    if ts is None:
+        ts = round(time.time(), 3)
+    if run_id is None:
+        run_id = (sha[:9] if sha else "run") + f"-{int(ts)}"
+    return {"v": LEDGER_VERSION, "run_id": run_id, "git_sha": sha,
+            "ts": ts, "device_kind": headline.get("device_kind", "unknown"),
+            "bench": dict(headline)}
+
+
+def append_entry(path: str | Path, headline: dict[str, Any], *,
+                 run_id: str | None = None, sha: str | None = None,
+                 ts: float | None = None) -> dict[str, Any]:
+    """Append one headline to the ledger (sha auto-detected when not
+    given); returns the entry written."""
+    if sha is None:
+        sha = git_sha(Path(path).resolve().parent)
+    entry = make_entry(headline, run_id=run_id, sha=sha, ts=ts)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+    return entry
+
+
+def read_ledger(path: str | Path) -> list[dict[str, Any]]:
+    """Load the ledger; every line must parse (this file is written a
+    whole line at a time — garbage means hand-editing went wrong)."""
+    entries = []
+    for i, line in enumerate(Path(path).read_text().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            e = json.loads(line)
+        except ValueError:
+            raise ValueError(f"{path}: line {i + 1} is not JSON: "
+                             f"{line[:80]!r}")
+        if not isinstance(e, dict) or "bench" not in e:
+            raise ValueError(f"{path}: line {i + 1} is not a ledger "
+                             f"entry: {line[:80]!r}")
+        entries.append(e)
+    return entries
+
+
+def _key(entry: dict[str, Any]) -> tuple[str, str]:
+    return (str(entry["bench"].get("metric", "?")),
+            str(entry.get("device_kind", "unknown")))
+
+
+def check_regression(entries: list[dict[str, Any]],
+                     candidate: dict[str, Any] | None = None, *,
+                     window: int = 8, min_history: int = 3,
+                     min_band_pct: float = 5.0) -> dict[str, Any]:
+    """Judge ``candidate`` (default: the ledger's newest entry) against
+    the trailing ``window`` earlier entries sharing its
+    ``(metric, device_kind)`` key.  Returns::
+
+        {"status": "ok"|"regression"|"no_baseline",
+         "key": [metric, device_kind], "run_id": ...,
+         "checks": [{"metric", "candidate", "baseline_median",
+                     "delta_pct", "band_pct", "n_baseline",
+                     "direction", "regressed"}, ...]}
+    """
+    if candidate is None:
+        if not entries:
+            raise ValueError("empty ledger and no candidate")
+        entries, candidate = entries[:-1], entries[-1]
+    key = _key(candidate)
+    baseline = [e for e in entries if _key(e) == key][-window:]
+    result: dict[str, Any] = {
+        "status": "ok", "key": list(key),
+        "run_id": candidate.get("run_id"), "checks": [],
+    }
+    if len(baseline) < min_history:
+        result["status"] = "no_baseline"
+        result["n_baseline"] = len(baseline)
+        return result
+    cand = candidate["bench"]
+    for name, direction in TRACKED_METRICS.items():
+        cv = cand.get(name)
+        if not isinstance(cv, (int, float)) or isinstance(cv, bool):
+            continue
+        hist = [e["bench"][name] for e in baseline
+                if isinstance(e["bench"].get(name), (int, float))
+                and not isinstance(e["bench"].get(name), bool)]
+        if len(hist) < min_history:
+            continue
+        med, spread, _ = trimmed_stats(hist)
+        if med == 0:
+            continue
+        delta = 100.0 * (float(cv) - med) / abs(med)
+        band = max(float(min_band_pct), spread / 2.0)
+        adverse = -delta if direction == "higher" else delta
+        regressed = adverse > band
+        result["checks"].append({
+            "metric": name, "candidate": float(cv),
+            "baseline_median": med, "delta_pct": round(delta, 2),
+            "band_pct": round(band, 2), "n_baseline": len(hist),
+            "direction": direction, "regressed": regressed,
+        })
+        if regressed:
+            result["status"] = "regression"
+    return result
+
+
+def format_report(result: dict[str, Any]) -> str:
+    """Human-readable per-metric delta report."""
+    key = result.get("key", ["?", "?"])
+    lines = [f"bench regression check: {key[0]} @ {key[1]} "
+             f"(run {result.get('run_id')}) -> {result['status'].upper()}"]
+    if result["status"] == "no_baseline":
+        lines.append(f"  only {result.get('n_baseline', 0)} prior "
+                     "entries with this (metric, device_kind) key — "
+                     "nothing to judge against yet")
+    for c in result.get("checks", []):
+        arrow = "REGRESSED" if c["regressed"] else "ok"
+        lines.append(
+            f"  {c['metric']:<28} {c['candidate']:>12.4g} vs median "
+            f"{c['baseline_median']:>12.4g} ({c['delta_pct']:+7.2f}% | "
+            f"band ±{c['band_pct']:.1f}%, n={c['n_baseline']}) {arrow}")
+    return "\n".join(lines)
+
+
+def _load_candidate(path: str) -> dict[str, Any]:
+    """A candidate file is either a ledger entry line, a bench stdout
+    capture (comment lines + JSON lines — the first JSON line is the
+    headline), or a bare headline JSON object."""
+    text = Path(path).read_text()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        obj = json.loads(line)
+        if "bench" in obj and "run_id" in obj:
+            return obj
+        return make_entry(obj, run_id=f"candidate:{Path(path).name}",
+                          sha=None)
+    raise ValueError(f"{path}: no JSON object line found")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("ledger", metavar="BENCH_HISTORY_JSONL")
+    ap.add_argument("--candidate", default=None, metavar="PATH",
+                    help="judge this bench output / ledger-entry file "
+                         "instead of the ledger's newest entry")
+    ap.add_argument("--window", type=int, default=8,
+                    help="trailing entries forming the baseline")
+    ap.add_argument("--min-history", type=int, default=3,
+                    help="baseline entries required before judging")
+    ap.add_argument("--min-band", type=float, default=5.0,
+                    help="noise-band floor (%%) when the trailing "
+                         "spread is tighter")
+    ap.add_argument("--advisory", action="store_true",
+                    help="report but always exit 0 (CI annotation mode)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the check result as JSON here")
+    args = ap.parse_args(argv)
+
+    try:
+        entries = read_ledger(args.ledger)
+        candidate = (_load_candidate(args.candidate)
+                     if args.candidate else None)
+        result = check_regression(entries, candidate,
+                                  window=args.window,
+                                  min_history=args.min_history,
+                                  min_band_pct=args.min_band)
+    except (OSError, ValueError) as e:
+        print(f"regress: FAIL {e}", file=sys.stderr)
+        return 2
+    print(format_report(result))
+    if args.json:
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(args.json, json.dumps(result, indent=2))
+    if result["status"] == "regression" and not args.advisory:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

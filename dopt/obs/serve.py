@@ -1,0 +1,159 @@
+"""Scrape endpoint over a live telemetry stream: ``python -m dopt.obs.serve``.
+
+Promotes the ``PrometheusSink`` text snapshot into a real HTTP scrape
+surface for long soak runs: a stdlib ``http.server`` that tails a
+growing metrics JSONL file (byte-offset watermark — each request
+processes only the bytes appended since the last one) and serves
+
+* ``GET /metrics``  — Prometheus text exposition: latest round
+  metrics and gauges (``engine_kind``-labelled), fault counters, and
+  ``dopt_alerts_total`` from the attached ``HealthMonitor``;
+* ``GET /healthz``  — the monitor's live ``HealthReport`` verdict as
+  JSON; HTTP 200 while the verdict is healthy/warn/empty, 503 once a
+  critical rule fired (the shape load balancers and soak harnesses
+  poll).
+
+Stdlib-only (no jax): point it at a metrics file scp'd off a TPU pod
+or written live by a local run::
+
+    python -m dopt.run --preset baseline1 --rounds 1000 \
+        --metrics-out metrics.jsonl &
+    python -m dopt.obs.serve metrics.jsonl --port 8000
+    curl localhost:8000/metrics
+    curl localhost:8000/healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+
+from dopt.obs.monitor import HealthMonitor, JsonlTail
+from dopt.obs.rules import Rule
+from dopt.obs.sinks import PrometheusSink
+
+
+class MetricsServer:
+    """Tail a metrics JSONL file and serve /metrics + /healthz.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``
+    after ``start()``) — the smoke-test mode.  Each request refreshes
+    the tail under a lock, so concurrent scrapes see a consistent
+    snapshot and the file is read incrementally, never re-parsed."""
+
+    def __init__(self, metrics_path: str | Path, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 rules: list[Rule] | None = None,
+                 workers: int | None = None):
+        self.metrics_path = Path(metrics_path)
+        self.monitor = HealthMonitor(rules, workers=workers)
+        self.prom = PrometheusSink()
+        self._tail = JsonlTail(self.metrics_path)
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def refresh(self) -> None:
+        """Process the bytes appended since the previous refresh."""
+        with self._lock:
+            for ev in self._tail.poll():
+                self.prom.emit(ev)
+                for alert in self.monitor.observe(ev):
+                    self.prom.emit(alert)
+
+    def render_metrics(self) -> str:
+        self.refresh()
+        return self.prom.render()
+
+    def render_health(self) -> tuple[int, str]:
+        self.refresh()
+        report = self.monitor.report()
+        body = report.to_dict()
+        body["metrics_path"] = str(self.metrics_path)
+        return (200 if report.ok else 503), json.dumps(body, indent=2)
+
+    def _handler(self) -> type[BaseHTTPRequestHandler]:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    body = server.render_metrics().encode()
+                    self._reply(200, body,
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    code, text = server.render_health()
+                    self._reply(code, text.encode(), "application/json")
+                elif path == "/":
+                    self._reply(200, b"dopt.obs.serve: /metrics /healthz\n",
+                                "text/plain")
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass  # scrapes every few seconds would flood stderr
+
+        return Handler
+
+    def start(self) -> "MetricsServer":
+        """Serve in a daemon thread (the smoke-test / embedded mode)."""
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("metrics", metavar="METRICS_JSONL",
+                    help="telemetry stream to tail (may not exist yet — "
+                         "the tail waits for it)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="fleet-size denominator override for rules "
+                         "(normally recovered from the stream's run "
+                         "header)")
+    args = ap.parse_args(argv)
+
+    server = MetricsServer(args.metrics, host=args.host, port=args.port,
+                           workers=args.workers)
+    print(f"serving {args.metrics} on http://{args.host}:{server.port} "
+          f"(/metrics, /healthz)", file=sys.stderr)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

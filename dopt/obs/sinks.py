@@ -190,44 +190,98 @@ class MemorySink(Sink):
         return iter(self.events)
 
 
+# The Prometheus exposition charset: metric names must match
+# [a-zA-Z_:][a-zA-Z0-9_:]*.  Dotted/hyphenated event keys (e.g. a
+# producer gauge named "host.gap-pct") must be sanitized or the scrape
+# is rejected wholesale by a strict parser.  Colons are legal but
+# reserved by convention for recording rules, so we map them away too.
 _METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_LABEL_ESC_RE = re.compile(r'(["\\\n])')
 
 
 def _metric_name(name: str) -> str:
-    return "dopt_" + _METRIC_NAME_RE.sub("_", name)
+    n = _METRIC_NAME_RE.sub("_", str(name))
+    # The "dopt_" prefix also guarantees a legal first character, so a
+    # leading digit in the event key cannot produce an invalid name.
+    return "dopt_" + (n or "metric")
+
+
+def _label_value(v: str) -> str:
+    """Escape per the exposition format: backslash, quote, newline."""
+    return _LABEL_ESC_RE.sub(
+        lambda m: {"\\": r"\\", '"': r"\"", "\n": r"\n"}[m.group(1)],
+        str(v))
 
 
 class PrometheusSink(Sink):
-    """Latest-value snapshot in Prometheus text-exposition format."""
+    """Latest-value snapshot in Prometheus text-exposition format.
+
+    Gauge names are sanitized to the Prometheus charset, every family
+    gets ``# HELP``/``# TYPE`` lines, and the producing engine rides
+    an ``engine_kind`` LABEL (one metric family per signal, one series
+    per engine) instead of being baked into names — the shape scrapers
+    can aggregate across."""
 
     def __init__(self, path: str | Path | None = None):
         self.path = Path(path) if path is not None else None
-        self._gauges: dict[str, float] = {}
+        # family name -> (help text, {engine_label_or_None: value})
+        self._gauges: dict[str, tuple[str, dict[str | None, float]]] = {}
         self._faults: dict[str, int] = {}
+        self._alerts: dict[tuple[str, str], int] = {}
+
+    def _set(self, name: str, help_: str, engine: str | None,
+             value: float) -> None:
+        fam = self._gauges.setdefault(_metric_name(name), (help_, {}))
+        fam[1][engine] = float(value)
 
     def emit(self, event: dict[str, Any]) -> None:
         kind = event.get("kind")
         if kind == "round":
-            self._gauges["dopt_round"] = float(event["round"])
+            eng = event.get("engine")
+            self._set("round", "latest completed training round", eng,
+                      float(event["round"]))
             for k, v in event.get("metrics", {}).items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    self._gauges[_metric_name(k)] = float(v)
+                    self._set(k, f"latest value of round metric {k!r}",
+                              eng, float(v))
         elif kind == "gauge":
-            self._gauges[_metric_name(event["name"])] = float(event["value"])
+            self._set(event["name"],
+                      f"latest value of gauge {event['name']!r}",
+                      event.get("engine"), float(event["value"]))
         elif kind == "fault":
             f = str(event["fault"])
             self._faults[f] = self._faults.get(f, 0) + 1
+        elif kind == "alert":
+            key = (str(event["rule"]), str(event.get("severity", "warn")))
+            self._alerts[key] = self._alerts.get(key, 0) + 1
 
     def render(self) -> str:
         lines = []
         for name in sorted(self._gauges):
+            help_, series = self._gauges[name]
+            lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {self._gauges[name]!r}")
+            for eng in sorted(series, key=lambda e: e or ""):
+                label = (f'{{engine_kind="{_label_value(eng)}"}}'
+                         if eng else "")
+                lines.append(f"{name}{label} {series[eng]!r}")
         if self._faults:
+            lines.append("# HELP dopt_faults_total fault-ledger rows "
+                         "observed, by ledger kind")
             lines.append("# TYPE dopt_faults_total counter")
             for kind in sorted(self._faults):
                 lines.append(
-                    f'dopt_faults_total{{kind="{kind}"}} {self._faults[kind]}')
+                    f'dopt_faults_total{{kind="{_label_value(kind)}"}} '
+                    f'{self._faults[kind]}')
+        if self._alerts:
+            lines.append("# HELP dopt_alerts_total health-rule alerts "
+                         "fired, by rule and severity")
+            lines.append("# TYPE dopt_alerts_total counter")
+            for rule, sev in sorted(self._alerts):
+                lines.append(
+                    f'dopt_alerts_total{{rule="{_label_value(rule)}",'
+                    f'severity="{_label_value(sev)}"}} '
+                    f'{self._alerts[(rule, sev)]}')
         return "\n".join(lines) + "\n"
 
     def write(self, path: str | Path | None = None) -> Path:

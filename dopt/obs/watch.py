@@ -1,0 +1,195 @@
+"""Live terminal tail over a growing metrics file: ``python -m dopt.obs.watch``.
+
+The at-a-glance view of a run *while it trains*: rounds/sec (from the
+round events' wall clocks), the loss curve's latest point, fleet gauges
+(quarantine load, consensus distance), fault counts, the latest phase
+fractions, and every health alert the attached ``HealthMonitor`` fires
+— all from incremental polls of the JSONL stream (byte-offset tail, so
+a million-round file costs nothing to keep watching).
+
+Stdlib-only (no jax): run it on a laptop against a file scp'd or
+streamed off the training host::
+
+    python -m dopt.obs.watch metrics.jsonl            # live, 2s refresh
+    python -m dopt.obs.watch metrics.jsonl --once     # one snapshot
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from collections import deque
+from typing import Any
+
+from dopt.obs.monitor import HealthMonitor, JsonlTail
+from dopt.obs.rules import loss_of
+
+# Wall-clock window (round events) for the rounds/sec estimate.
+_RATE_WINDOW = 32
+
+
+class WatchState:
+    """Incremental reduction of the event stream into one screenful."""
+
+    def __init__(self, monitor: HealthMonitor):
+        self.monitor = monitor
+        self.tail: JsonlTail | None = None
+        self.run: dict[str, Any] | None = None
+        self.round: int | None = None
+        self.loss_key: str | None = None
+        self.loss: float | None = None
+        self.metrics: dict[str, Any] = {}
+        self.gauges: dict[str, float] = {}
+        self.faults: dict[str, int] = {}
+        self.phases: dict[str, float] | None = None
+        self.events = 0
+        # Alerts EMBEDDED in the stream (a producer-side monitor wrote
+        # them) — kept separate from self.monitor's own firings, which
+        # may use different rule parameters.
+        self.stream_alerts: list[dict[str, Any]] = []
+        self._round_ts: deque[float] = deque(maxlen=_RATE_WINDOW)
+
+    def poll(self, path: str) -> list[dict[str, Any]]:
+        """Feed the events appended to ``path`` since the last poll
+        (byte-offset tail); returns the alerts they fired."""
+        if self.tail is None:
+            self.tail = JsonlTail(path)
+        return self.feed(self.tail.poll())
+
+    def feed(self, events: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        """Consume a poll's events; returns the alerts fired by it."""
+        fired: list[dict[str, Any]] = []
+        for ev in events:
+            self.events += 1
+            fired.extend(self.monitor.observe(ev))
+            kind = ev.get("kind")
+            if kind == "run":
+                self.run = ev
+            elif kind == "round":
+                self.round = ev.get("round")
+                self.metrics = ev.get("metrics", {})
+                k, v = loss_of(self.metrics)
+                if k is not None:
+                    self.loss_key, self.loss = k, v
+                ts = ev.get("ts")
+                if isinstance(ts, (int, float)):
+                    self._round_ts.append(float(ts))
+            elif kind == "gauge":
+                self.gauges[str(ev.get("name"))] = float(ev.get("value", 0))
+            elif kind == "fault":
+                f = str(ev.get("fault"))
+                self.faults[f] = self.faults.get(f, 0) + 1
+            elif kind == "phase":
+                self.phases = ev.get("fractions")
+            elif kind == "alert":
+                self.stream_alerts.append(ev)
+        return fired
+
+    def all_alerts(self) -> list[dict[str, Any]]:
+        """Stream-embedded alerts plus this watcher's own firings,
+        minus own firings that duplicate an embedded one (same rule at
+        the same round — the producer's monitor and the stock local
+        rules re-deriving the same condition from the same events)."""
+        seen = {(a.get("rule"), a.get("round"), a.get("severity"))
+                for a in self.stream_alerts}
+        return self.stream_alerts + [
+            a for a in self.monitor.alerts
+            if (a.get("rule"), a.get("round"), a.get("severity"))
+            not in seen]
+
+    def critical(self) -> bool:
+        """Any critical alert, embedded in the stream or fired by this
+        watcher's own monitor."""
+        return any(a.get("severity") == "critical"
+                   for a in self.all_alerts())
+
+    def rounds_per_sec(self) -> float | None:
+        ts = self._round_ts
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return None
+        return (len(ts) - 1) / (ts[-1] - ts[0])
+
+    def render(self) -> str:
+        lines = []
+        run = self.run or {}
+        head = (f"dopt watch — {run.get('name', '?')} "
+                f"[{run.get('engine', '?')}"
+                + (f", {run['workers']} workers" if run.get("workers")
+                   else "") + "]")
+        lines.append(head)
+        rps = self.rounds_per_sec()
+        lines.append(
+            f"  round {self.round if self.round is not None else '-'}"
+            + (f" @ {rps:.3f} rounds/s" if rps else "")
+            + (f" | {self.loss_key}={self.loss:.5g}"
+               if self.loss is not None and self.loss_key else
+               (f" | {self.loss_key}=non-finite" if self.loss_key else "")))
+        shown = {k: v for k, v in self.gauges.items()
+                 if k in ("quarantine_active", "stale_pending",
+                          "consensus_distance", "cohort_size",
+                          "participating_lanes", "host_gap_pct")}
+        if shown:
+            lines.append("  gauges  " + "  ".join(
+                f"{k}={v:g}" for k, v in sorted(shown.items())))
+        if self.faults:
+            lines.append("  faults  " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.faults.items())))
+        if self.phases:
+            lines.append("  phases  " + "  ".join(
+                f"{k}={v:.0%}" for k, v in sorted(self.phases.items())))
+        rep = self.monitor.report()
+        alerts = self.all_alerts()
+        verdict = "CRITICAL" if self.critical() else \
+            ("WARN" if alerts else rep.verdict.upper())
+        lines.append(f"  health  {verdict} "
+                     f"({len(alerts)} alerts, {rep.rounds} rounds, "
+                     f"{self.events} events)")
+        for a in alerts[-5:]:
+            lines.append(f"  ALERT [{a.get('severity')}] "
+                         f"{a.get('rule')} @ round {a.get('round')}: "
+                         f"{a.get('message')}")
+        return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("metrics", metavar="METRICS_JSONL")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period, seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot of the current file and "
+                         "exit (CI / scripting mode)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append snapshots instead of redrawing in "
+                         "place (for dumb terminals / logs)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="fleet-size denominator override for rules")
+    args = ap.parse_args(argv)
+
+    monitor = HealthMonitor(workers=args.workers)
+    state = WatchState(monitor)
+    try:
+        while True:
+            fired = state.poll(args.metrics)
+            if args.once:
+                print(state.render())
+                return 1 if state.critical() else 0
+            if not args.no_clear:
+                # Home + clear-to-end: redraw in place without
+                # scrollback spam.
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print(state.render(), flush=True)
+            for a in fired:
+                # New alerts also go to stderr so a piped log keeps them.
+                print(f"ALERT [{a.get('severity')}] {a.get('rule')} "
+                      f"@ round {a.get('round')}: {a.get('message')}",
+                      file=sys.stderr)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,6 +23,15 @@ things a robust trainer owes you:
    it spawns a child process, SIGKILLs it mid-round-loop, and resumes
    from whatever checkpoint survived; the default does the same
    in-process (deterministic, CI-friendly).
+5. **Monitor invariants** (dopt.obs.monitor) — the streaming
+   ``HealthMonitor``'s alert sequence is identical across per-round,
+   fused-blocked and killed-and-resumed execution of the same seed
+   (the canonical-stream guarantee lifted to alerts); the stock rule
+   set raises ZERO alerts on clean baseline1/baseline3-shaped runs
+   (the false-positive gate); and a deliberately injected divergence —
+   a corrupt scale bomb against ``aggregator='mean'`` — MUST fire the
+   ``loss_divergence`` rule before the run ends.  ``--report-out``
+   writes the legs' HealthReports as one JSON artifact for CI.
 
 The cocktail's knobs are drawn from seeded ranges (``--seed``), so
 ``--seed N`` gives N distinct-but-reproducible storms.
@@ -111,6 +120,17 @@ def build_trainer(engine: str, seed: int, rounds: int,
             else FederatedTrainer(cfg))
 
 
+def cocktail_rules():
+    """The monitor rule set for the cocktail legs: the stock set with
+    the drop-rate SLO tightened far below the storm's actual loss rate,
+    so the soak's alert-sequence-equality invariant compares real
+    firings, not three empty lists."""
+    from dopt.obs.rules import default_rules
+
+    return default_rules(drop_rate={"max_rate": 0.05, "window": 4,
+                                    "min_rounds": 2})
+
+
 def check_ledger(history, rounds: int, workers: int) -> int:
     """Schema + range invariants over every fault-ledger row."""
     for row in history.faults:
@@ -140,16 +160,21 @@ def check_convergence(history, tol: float) -> tuple[float, float]:
 
 def soak_one(engine: str, seed: int, rounds: int, tol: float,
              ckpt_dir: str, kill: bool, metrics_sink=None,
-             prefetch: bool = False) -> None:
-    from dopt.obs import (JsonlSink, MemorySink, Telemetry, attach,
-                          canonical, check_stream)
+             prefetch: bool = False):
+    from dopt.obs import (HealthMonitor, JsonlSink, MemorySink, Telemetry,
+                          attach, canonical, check_stream)
 
     w = _DATA.num_users
     print(f"[{engine}] cocktail seed={seed}: continuous run ...")
     cont = build_trainer(engine, seed, rounds)
     mem = MemorySink()
     sinks = [mem] + ([metrics_sink] if metrics_sink is not None else [])
-    attach(cont, Telemetry(sinks), fresh=True)
+    tele_c = Telemetry(sinks)
+    # The streaming monitor rides the continuous run IN-PROCESS (sink
+    # attachment): alerts fire while it trains and are forwarded into
+    # the stream.
+    mon_c = HealthMonitor(cocktail_rules()).attach(tele_c)
+    attach(cont, tele_c, fresh=True)
     hc = cont.run(rounds=rounds)
     first, last = check_convergence(hc, tol)
     n_rows = check_ledger(hc, rounds, w)
@@ -185,7 +210,9 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     # bit-identity claim of the overlap work.
     blk = build_trainer(engine, seed, rounds, prefetch=prefetch)
     mem_b = MemorySink()
-    attach(blk, Telemetry([mem_b]), fresh=True)
+    tele_b = Telemetry([mem_b])
+    mon_b = HealthMonitor(cocktail_rules()).attach(tele_b)
+    attach(blk, tele_b, fresh=True)
     hb = blk.run(rounds=rounds, block=max(rounds // 2, 2))
     assert hb.rows == hc.rows, \
         f"blocked History diverged from per-round ({engine})"
@@ -193,9 +220,11 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
         f"blocked fault ledger diverged from per-round ({engine})"
     assert canonical(mem_b.events) == canonical(mem.events), \
         f"blocked telemetry stream diverged from per-round ({engine})"
+    assert mon_b.canonical_alerts() == mon_c.canonical_alerts(), \
+        f"blocked-run alert sequence diverged from per-round ({engine})"
     print(f"[{engine}] fused-block execution bit-identical ok "
-          f"(History + ledger + event stream"
-          f"{', prefetch armed' if prefetch else ''})")
+          f"(History + ledger + event stream + {len(mon_c.alerts)} "
+          f"alerts{', prefetch armed' if prefetch else ''})")
 
     # Kill-and-resume bit-identity, including the telemetry stream's
     # monotonic round watermark: the resumed run APPENDS to the dead
@@ -236,8 +265,101 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     assert (canonical(merged, kinds=("round", "fault"))
             == canonical(mem.events, kinds=("round", "fault"))), \
         f"resumed telemetry stream diverged from continuous ({engine})"
+    # The monitor over the MERGED killed-and-resumed stream (the resume
+    # header keeps the rule windows) fires the same alert sequence the
+    # continuous in-process monitor did.
+    mon_r = HealthMonitor(cocktail_rules())
+    mon_r.feed(merged)
+    assert mon_r.canonical_alerts() == mon_c.canonical_alerts(), \
+        f"resumed-stream alert sequence diverged from continuous ({engine})"
     print(f"[{engine}] {'SIGKILL' if kill else 'in-process kill'}"
-          f"-and-resume bit-identical ok (stream watermark gapless)")
+          f"-and-resume bit-identical ok (stream watermark gapless, "
+          f"alert sequence identical)")
+    return mon_c.report()
+
+
+def clean_baseline_gate(rounds: int):
+    """False-positive gate: the STOCK rule set must raise zero alerts
+    on clean baseline1/baseline3-shaped runs (the preset's algorithm /
+    topology / optimizer, soak-scale synthetic data, and the mlp model
+    — model1 is CPU-unviable in CI, the bench --quick precedent).  A
+    monitor that cries wolf on a healthy run is worse than no monitor.
+    Returns {preset_name: HealthReport}."""
+    import dataclasses
+
+    from dopt.obs import HealthMonitor, MemorySink, Telemetry, attach
+    from dopt.presets import PRESETS
+
+    reports = {}
+    for name in ("baseline1", "baseline3"):
+        cfg = PRESETS[name]()
+        cfg = dataclasses.replace(
+            cfg,
+            data=dataclasses.replace(
+                cfg.data, synthetic_train_size=_DATA.synthetic_train_size,
+                synthetic_test_size=_DATA.synthetic_test_size),
+            model=_MODEL)
+        if cfg.gossip is not None:
+            cfg = dataclasses.replace(
+                cfg, gossip=dataclasses.replace(
+                    cfg.gossip, rounds=rounds, local_ep=1, local_bs=32))
+            from dopt.engine import GossipTrainer as Trainer
+        else:
+            cfg = dataclasses.replace(
+                cfg, federated=dataclasses.replace(
+                    cfg.federated, rounds=rounds, local_ep=1, local_bs=32))
+            from dopt.engine import FederatedTrainer as Trainer
+        print(f"[clean] {name}: {rounds} rounds, stock rule set ...")
+        trainer = Trainer(cfg)
+        tele = Telemetry([MemorySink()])
+        mon = HealthMonitor().attach(tele)   # stock default_rules()
+        attach(trainer, tele, fresh=True)
+        trainer.run(rounds=rounds)
+        rep = mon.report()
+        assert rep.alerts == 0 and rep.verdict == "healthy", \
+            (f"false-positive gate: clean {name} run raised "
+             f"{rep.alerts} alerts: {mon.canonical_alerts()}")
+        print(f"[clean] {name}: verdict={rep.verdict}, 0 alerts ok")
+        reports[name] = rep
+    return reports
+
+
+def divergence_gate(rounds: int):
+    """Detection gate: a corrupt scale bomb (persistent adversaries
+    blowing their update up 30x) against the UNDEFENDED mean
+    aggregator must diverge the fleet — and the monitor's
+    loss_divergence rule MUST fire before the run ends.  30x is the
+    PROGRESSIVE regime: the loss rises finitely for a few rounds
+    before overflowing, so the divergence rule (which needs a finite
+    trailing median) catches it before the NaN does — a bigger bomb
+    (1e3) jumps straight to non-finite and only loss_nonfinite can
+    see it.  Returns the HealthReport."""
+    from dopt.engine import FederatedTrainer
+    from dopt.obs import HealthMonitor, MemorySink, Telemetry, attach
+
+    cfg = ExperimentConfig(
+        name="chaos-divergence-bomb", seed=7, data=_DATA, model=_MODEL,
+        optim=_OPTIM,
+        federated=FederatedConfig(algorithm="fedavg", frac=0.5,
+                                  rounds=rounds, local_ep=1, local_bs=32),
+        faults=FaultConfig(corrupt=1.0, corrupt_max=2,
+                           corrupt_mode="scale", corrupt_scale=30.0))
+    print(f"[divergence] scale bomb vs aggregator='mean': {rounds} "
+          "rounds ...")
+    trainer = FederatedTrainer(cfg)
+    tele = Telemetry([MemorySink()])
+    mon = HealthMonitor().attach(tele)
+    attach(trainer, tele, fresh=True)
+    trainer.run(rounds=rounds)
+    rep = mon.report()
+    fired = {a["rule"] for a in mon.alerts}
+    assert "loss_divergence" in fired, \
+        (f"divergence gate: the scale bomb did not fire loss_divergence "
+         f"(fired: {sorted(fired)}; report {rep.to_dict()})")
+    assert not rep.ok, f"divergence must be CRITICAL: {rep.to_dict()}"
+    print(f"[divergence] fired {sorted(fired)} -> verdict "
+          f"{rep.verdict} ok")
+    return rep
 
 
 def _sigkill_child(engine: str, seed: int, rounds: int, kill_at: int,
@@ -310,6 +432,14 @@ def main(argv: list[str] | None = None) -> int:
                          "(dopt.obs JSONL, one segment per engine) here "
                          "— the CI artifact; validate with "
                          "'python -m dopt.obs.check PATH'")
+    ap.add_argument("--report-out", default=None, metavar="PATH",
+                    help="write the legs' HealthReports (cocktail "
+                         "monitors + clean false-positive gate + "
+                         "divergence gate) as one JSON artifact here")
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="run only the cocktail legs (skip the clean "
+                         "false-positive and divergence-detection "
+                         "monitor gates)")
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--ckpt", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--child-metrics", default=None, help=argparse.SUPPRESS)
@@ -328,17 +458,32 @@ def main(argv: list[str] | None = None) -> int:
         from dopt.obs import JsonlSink
 
         metrics_sink = JsonlSink(args.metrics_out)
+    reports = {}
     with tempfile.TemporaryDirectory() as tmp:
         ckpt_dir = args.ckpt_dir or tmp
         for engine in engines:
-            soak_one(engine, args.seed, args.rounds, args.tol, ckpt_dir,
-                     args.kill, metrics_sink=metrics_sink,
-                     prefetch=args.prefetch)
+            reports[f"cocktail_{engine}"] = soak_one(
+                engine, args.seed, args.rounds, args.tol, ckpt_dir,
+                args.kill, metrics_sink=metrics_sink,
+                prefetch=args.prefetch)
+    if not args.skip_gates:
+        for name, rep in clean_baseline_gate(args.rounds).items():
+            reports[f"clean_{name}"] = rep
+        reports["divergence_bomb"] = divergence_gate(args.rounds)
     if metrics_sink is not None:
         metrics_sink.close()
         print(f"wrote telemetry stream to {args.metrics_out}")
+    if args.report_out:
+        import json
+
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(args.report_out, json.dumps(
+            {k: r.to_dict() for k, r in reports.items()}, indent=2))
+        print(f"wrote health reports to {args.report_out}")
     print("chaos soak passed: convergence + ledger + checkpoint + "
-          "telemetry-stream invariants hold under the full cocktail")
+          "telemetry-stream + monitor invariants hold under the full "
+          "cocktail")
     return 0
 
 

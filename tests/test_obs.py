@@ -263,10 +263,13 @@ def test_prometheus_snapshot(tmp_path):
     t.emit_round_bundle(1, engine="f", metrics={"test_acc": 0.75})
     t.close()
     text = (tmp_path / "prom.txt").read_text()
-    assert "dopt_round 1.0" in text
-    assert "dopt_test_acc 0.75" in text        # latest value wins
-    assert "dopt_stale_pending 2.0" in text
+    # engine_kind rides as a LABEL (one family per signal, one series
+    # per engine), with # HELP/# TYPE lines per family.
+    assert 'dopt_round{engine_kind="f"} 1.0' in text
+    assert 'dopt_test_acc{engine_kind="f"} 0.75' in text   # latest wins
+    assert 'dopt_stale_pending{engine_kind="f"} 2.0' in text
     assert 'dopt_faults_total{kind="crash"} 2' in text
+    assert "# HELP dopt_round" in text and "# TYPE dopt_round gauge" in text
 
 
 def test_span_tracer_nesting_and_chrome_export(tmp_path):
