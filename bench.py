@@ -97,7 +97,7 @@ def _device_peak_flops() -> tuple[str, float | None]:
 
 def _config(*, fast: bool, train_size: int, test_size: int,
             faithful_model: bool = True, update_sharding: str = "off",
-            prefetch: str = "off"):
+            prefetch: str = "off", diagnostics: str = "off"):
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
                              ModelConfig, OptimizerConfig)
 
@@ -126,12 +126,12 @@ def _config(*, fast: bool, train_size: int, test_size: int,
                             mode="stochastic", rounds=10, local_ep=4,
                             local_bs=128,
                             update_sharding=update_sharding,
-                            prefetch=prefetch),
+                            prefetch=prefetch, diagnostics=diagnostics),
     )
 
 
 def _chaos_config(*, train_size: int, test_size: int,
-                  prefetch: str = "off"):
+                  prefetch: str = "off", diagnostics: str = "off"):
     """The degraded-network cocktail on the headline workload:
     msg_drop (lossy links) + stragglers + Byzantine scale-lies +
     quarantine armed.  Every one of these modes used to force
@@ -160,7 +160,8 @@ def _chaos_config(*, train_size: int, test_size: int,
         optim=OptimizerConfig(lr=0.05, momentum=0.5),
         gossip=GossipConfig(algorithm="dsgd", topology="circle",
                             mode="metropolis", rounds=20, local_ep=2,
-                            local_bs=64, prefetch=prefetch),
+                            local_bs=64, prefetch=prefetch,
+                            diagnostics=diagnostics),
         faults=FaultConfig(msg_drop=0.15, straggle=0.25, straggle_frac=0.5,
                            corrupt=0.15, corrupt_mode="scale",
                            corrupt_scale=10.0),
@@ -170,7 +171,8 @@ def _chaos_config(*, train_size: int, test_size: int,
 
 def _measure_chaos(train_size: int, test_size: int, rounds: int,
                    repeats: int, telemetry=None,
-                   prefetch: str = "off") -> dict:
+                   prefetch: str = "off",
+                   diagnostics: str = "off") -> dict:
     """Chaos-cocktail throughput, both execution paths: ``blocked``
     (all measured rounds in one fused lax.scan dispatch — the path this
     PR opened to degraded modes) and ``per_round`` (one jit dispatch +
@@ -182,12 +184,16 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
     # emission happens inside the timed window, so telemetering only
     # one leg would skew the blocked-vs-per-round speedup ratio with
     # --metrics-out — the ratio must compare like with like.
+    # Diagnostics (when armed) ride BOTH legs, like telemetry: the
+    # speedup ratio must compare like with like.
     blocked = _measure(_chaos_config(train_size=train_size,
                                      test_size=test_size,
-                                     prefetch=prefetch),
+                                     prefetch=prefetch,
+                                     diagnostics=diagnostics),
                        rounds, rounds, repeats, telemetry=telemetry)
     per_round = _measure(_chaos_config(train_size=train_size,
-                                       test_size=test_size),
+                                       test_size=test_size,
+                                       diagnostics=diagnostics),
                          rounds, 1, repeats, telemetry=telemetry)
     return {
         "gossip_rounds_per_sec_chaos": round(blocked["rounds_per_sec"], 4),
@@ -532,6 +538,12 @@ def main() -> None:
                          "'off' by construction.  The faithful f32 leg "
                          "always runs 'off' (the oracle-parity host "
                          "loop)")
+    ap.add_argument("--skip-diagnostics", action="store_true",
+                    help="skip the diagnostics-overhead leg (the fast "
+                         "workload re-measured with GossipConfig."
+                         "diagnostics='on'; its rounds/sec and overhead "
+                         "pct land in the headline JSON line — the "
+                         "acceptance bar is < 5%% vs diagnostics-off)")
     ap.add_argument("--device-blocks", type=int, default=3,
                     help="profiler-traced blocks for the device-time-basis "
                          "rounds/sec (tunnel-immune; 0 disables)")
@@ -600,9 +612,13 @@ def main() -> None:
         # enough to exercise both execution paths end to end and emit
         # the tracked JSON shape; the VALUE is only meaningful from a
         # real accelerator run (the full bench measures it properly).
+        # Diagnostics ride the chaos legs so the metrics artifact
+        # carries the convergence gauges + resource/compile events the
+        # CI gate asserts on.
         chaos = _measure_chaos(1_536, 512, rounds=args.rounds or 2,
                                repeats=2, telemetry=tele,
-                               prefetch=args.prefetch)
+                               prefetch=args.prefetch,
+                               diagnostics="on")
         quick_line = {"metric": "gossip_rounds_per_sec_chaos",
                       "value": chaos["gossip_rounds_per_sec_chaos"],
                       "unit": "rounds/sec", "quick": True,
@@ -613,7 +629,16 @@ def main() -> None:
                       "host_gap_pct": chaos["chaos_host_gap_pct"],
                       "host_batch_plan_fraction":
                           chaos["chaos_host_batch_plan_fraction"],
-                      "prefetch": args.prefetch, **chaos}
+                      "prefetch": args.prefetch,
+                      "diagnostics": "on", **chaos}
+        from dopt.utils.profiling import device_memory_stats
+
+        mem = device_memory_stats()
+        if mem is not None:
+            # Finite peak HBM in the quick artifact (host RSS on the
+            # CPU CI runner) — the other half of the CI gate.
+            quick_line["hbm_peak_gb"] = round(mem["peak_bytes"] / 2**30, 3)
+            quick_line["hbm_source"] = mem["source"]
         print(json.dumps(quick_line))
         if not args.skip_clients:
             # Client-scale quick line: the 1k-client baseline3 cohort
@@ -701,6 +726,36 @@ def main() -> None:
     if peak:
         result["mfu_vs_bf16_peak"] = round(
             fast_sps * MODEL1_TRAIN_FLOPS_PER_SAMPLE / peak, 4)
+    from dopt.utils.profiling import device_memory_stats
+
+    mem = device_memory_stats()
+    if mem is not None:
+        # Peak HBM of the fast leg's process (backend allocator stats
+        # on TPU/GPU, host RSS on CPU — `hbm_source` says which).
+        result["hbm_peak_gb"] = round(mem["peak_bytes"] / 2**30, 3)
+        result["hbm_source"] = mem["source"]
+    if not args.skip_diagnostics:
+        # Diagnostics-overhead leg: the IDENTICAL fast workload with
+        # GossipConfig.diagnostics="on" (the on-device norm/spread/
+        # consensus reductions + the packed-vector growth), so the
+        # headline carries the measured cost of per-round
+        # introspection.  The acceptance bar is < 5% rounds/sec.
+        diag = _measure(
+            _config(fast=True, train_size=train_size,
+                    test_size=test_size, faithful_model=faithful_model,
+                    update_sharding=args.update_sharding,
+                    prefetch=args.prefetch, diagnostics="on"),
+            rounds, block, repeats, max_spread=max_spread,
+            telemetry=tele)
+        result["diagnostics_rounds_per_sec"] = round(
+            diag["rounds_per_sec"], 4)
+        result["diagnostics_overhead_pct"] = round(
+            100.0 * (1.0 - diag["rounds_per_sec"]
+                     / fast["rounds_per_sec"]), 2)
+        print(f"# diagnostics on: {diag['rounds_per_sec']:.4f} r/s vs "
+              f"off {fast['rounds_per_sec']:.4f} r/s "
+              f"({result['diagnostics_overhead_pct']:+.2f}% overhead)",
+              file=sys.stderr)
     if not args.skip_chaos:
         # Second headline: the degraded-network cocktail at blocked
         # (fused-scan) speed, with the pre-change per-round path timed

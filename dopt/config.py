@@ -193,6 +193,21 @@ class FederatedConfig:
     # Rejected for population mode with client-keyed quarantine armed
     # (next round's eligibility depends on this round's screen
     # feedback, which only exists after the fetch).
+    diagnostics: str = "off"
+    # "off" | "on".  "on" computes per-round convergence diagnostics
+    # INSIDE the compiled round (global update/gradient/parameter L2
+    # norms, per-lane train-loss mean + max-min spread, and the fleet
+    # lane-dispersion mean_i ||p_i - theta||), threads them through the
+    # blocked lax.scan as extra packed outputs, and emits them as
+    # deterministic ``gauge`` telemetry at the post-fetch boundary —
+    # per-round, fused-blocked, prefetched and killed-and-resumed runs
+    # produce canonically identical diagnostic streams (dopt.obs).
+    # Also arms the non-deterministic device-resource channel
+    # (``resource`` HBM samples per block, ``compile`` retrace events)
+    # when telemetry is attached.  "off" (default) compiles the exact
+    # pre-change programs and runs the exact pre-change host loop.
+    # Rejected for population mode (stateless wave clients carry no
+    # lane momentum/params to diagnose).
 
 
 @dataclass(frozen=True)
@@ -331,6 +346,20 @@ class GossipConfig:
     # population mode (the gossip cohort binding mutates the registry
     # and appends its ledger row at plan time — the federated engine
     # is the prefetch-eligible population path).
+    diagnostics: str = "off"
+    # "off" | "on".  "on" computes per-round convergence diagnostics
+    # INSIDE the compiled round (global update/gradient/parameter L2
+    # norms, per-lane train-loss mean + max-min spread, and the TRUE
+    # per-round consensus distance mean_i ||p_i - p_bar||), threads
+    # them through the blocked lax.scan as extra packed outputs, and
+    # emits them as deterministic ``gauge`` telemetry at the post-fetch
+    # boundary — per-round, fused-blocked, prefetched and
+    # killed-and-resumed runs produce canonically identical diagnostic
+    # streams (dopt.obs).  Also arms the non-deterministic
+    # device-resource channel (``resource`` HBM samples per block,
+    # ``compile`` retrace events) when telemetry is attached.  "off"
+    # (default) compiles the exact pre-change programs and runs the
+    # exact pre-change host loop.
     dropout: float = 0.0
     # DEPRECATED back-compat alias for FaultConfig(crash=p) — warns at
     # trainer construction and produces the identical fault trace

@@ -54,7 +54,8 @@ from dopt.parallel.collectives import (broadcast_to_workers,
                                         masked_average,
                                         masked_average_scatter,
                                         where_mask as _where_mask)
-from dopt.robust import (clip_to_ball, finite_lane_mask, make_aggregator,
+from dopt.robust import (clip_to_ball, finite_lane_mask, global_norm_f32,
+                         lane_sq_norms, make_aggregator,
                          masked_mean, validate_robust_config)
 from dopt.parallel.mesh import (make_worker_mesh, shard_worker_tree,
                                 worker_axes, worker_sharding)
@@ -352,6 +353,34 @@ class FederatedTrainer:
             raise ValueError(
                 f"unknown prefetch {f.prefetch!r}; one of off|on")
         self._prefetch = f.prefetch == "on"
+        # Per-round convergence diagnostics (FederatedConfig.
+        # diagnostics): "on" computes the diag scalar block INSIDE the
+        # compiled round (full-width, compact and fused-chaos paths —
+        # it rides the packed host-metrics vector, so the blocked scans
+        # carry it as one more stacked output) and emits it as
+        # deterministic gauges at the post-fetch boundary, plus the
+        # non-deterministic resource/compile channel when telemetry is
+        # attached.  "off" (default) compiles the exact pre-change
+        # programs — every use below is python-gated on it.
+        if f.diagnostics not in ("off", "on"):
+            raise ValueError(
+                f"unknown diagnostics {f.diagnostics!r}; one of off|on")
+        self._diag = f.diagnostics == "on"
+        from dopt.obs.events import DIAG_GAUGES
+
+        # The packed block's emission names: the shared five + this
+        # engine's dispersion meter (round_diag's stack order).
+        self._diag_keys = DIAG_GAUGES + ("lane_dispersion",)
+        if self._diag and self._registry is not None:
+            raise ValueError(
+                "diagnostics='on' does not compose with population mode "
+                "(wave clients are stateless — there is no lane-carried "
+                "momentum/params for the convergence diagnostics to "
+                "measure) — drop one of the two")
+        from dopt.utils.profiling import CompileWatcher
+
+        self._compile_watch = CompileWatcher()
+        self._last_step_total = 0.0
         if (self._prefetch and self._registry is not None
                 and rcfg is not None and rcfg.quarantine_after > 0):
             raise ValueError(
@@ -656,9 +685,54 @@ class FederatedTrainer:
 
         has_stale = self._has_stale
         st_clip = clip_radius
+        diag_on = self._diag
+        _g_norm = global_norm_f32
+
+        def round_diag(p_lanes, p_start, m_new, theta_new, p_fleet,
+                       losses, mask):
+            """[6] f32 per-round diagnostics (dopt.obs.events.DIAG_GAUGES
+            + lane_dispersion), computed ON DEVICE from the round's
+            carried state so every execution path agrees bit-for-bit:
+            global L2 of the AGGREGATING lanes' displacement from their
+            round-start load (``p_lanes`` − ``p_start`` masked by
+            ``mask`` — a screened lane's carry reverts to its stale
+            pre-round params while its start was the theta load, so an
+            unmasked sum would read that accumulated drift as a giant
+            round update and false-fire grad_explosion; compact padding
+            lanes are masked out the same way), of the carried momentum
+            (zero for scaffold's per-round-local buffer), and of the
+            NEW global model; the aggregating-lane train-loss mean and
+            max−min spread; and the fleet dispersion
+            mean_i ||p_i − theta|| over ALL W carried lanes (stale-lane
+            drift is the signal)."""
+            upd = jnp.sqrt((lane_sq_norms(jax.tree.map(
+                lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                p_lanes, p_start)) * mask).sum())
+            lane = losses.mean(axis=1).astype(jnp.float32)
+            # The always-on screen keeps the carried trees finite, but a
+            # lane can pass it (finite params) while its train loss
+            # overflowed — fold loss-finiteness into the mask so one such
+            # lane can't blind the fleet loss meters (mirrors gossip's
+            # diagnosable-lane mask).
+            okl = mask * jnp.isfinite(lane)
+            denom = jnp.maximum(okl.sum(), 1.0)
+            lmean = (jnp.where(okl > 0, lane, 0.0)).sum() / denom
+            lmax = jnp.where(okl > 0, lane, -jnp.inf).max()
+            lmin = jnp.where(okl > 0, lane, jnp.inf).min()
+            spread = jnp.where(okl.sum() > 0, lmax - lmin, 0.0)
+            sq = None
+            for x, th in zip(jax.tree.leaves(p_fleet),
+                             jax.tree.leaves(theta_new)):
+                d = (x.astype(jnp.float32)
+                     - th.astype(jnp.float32)[None]).reshape(x.shape[0], -1)
+                s = (d * d).sum(axis=1)
+                sq = s if sq is None else sq + s
+            disp = jnp.sqrt(sq).mean()
+            return jnp.stack([upd, _g_norm(m_new), _g_norm(theta_new),
+                              lmean, spread, disp])
 
         def pack_host_metrics(local_loss, evalm, trainm, em, screened,
-                              stale_scr=None):
+                              stale_scr=None, diag=None):
             """Everything the host reads per round, as ONE flat f32
             vector — every device→host fetch pays a fixed ~100 ms tunnel
             round-trip on this hardware, so the round's history metrics
@@ -679,11 +753,16 @@ class FederatedTrainer:
             if use_holdout:
                 parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
                           em["val_acc"].ravel(), em["val_loss_sum"].ravel()]
+            if diag_on:
+                # Diagnostics block travels LAST so every earlier
+                # offset (_unpack_host_metrics, the chaos scan's
+                # screened-flag slice) is layout-stable.
+                parts.append(diag)
             return jnp.concatenate([p.astype(jnp.float32) for p in parts])
 
         def finish(new_theta, new_p, new_m, new_duals, new_c, local_loss,
                    em, screened, train_x, train_y, ex, ey, ew, tidx,
-                   tweight, stale_scr=None):
+                   tweight, stale_scr=None, diag=None):
             """Shared round tail: global test eval + all-client train eval
             (``avg_trainig_calculator``) — identical for both execution
             paths so the history schema can never diverge between them.
@@ -698,7 +777,8 @@ class FederatedTrainer:
                           "loss_sum": jnp.zeros(w), "count": jnp.ones(w)}
             return (new_theta, new_p, new_m, new_duals, new_c,
                     pack_host_metrics(jnp.asarray(local_loss), evalm,
-                                      trainm, em, screened, stale_scr))
+                                      trainm, em, screened, stale_scr,
+                                      diag))
 
         def round_fn(theta, params, mom, duals, c_global, mask, limits, idx,
                      bweight, train_x, train_y, ex, ey, ew, tidx, tweight,
@@ -813,12 +893,19 @@ class FederatedTrainer:
             # Sampled-and-screened flags travel to the host for the
             # ledger and the quarantine streaks.
             screened = mask * (1.0 - fin)
+            # Diagnostics from the CARRIED state: displacement of the
+            # carried lanes from their round-start load, carried
+            # momentum, the new global model, and the full-width fleet
+            # dispersion.
+            diag = (round_diag(new_p, start, new_m, new_theta, new_p,
+                               losses, agg_mask)
+                    if diag_on else None)
             # Full-width packs ALL W lanes' em rows (gathering the
             # sampled subset would be a dynamic shape); the host slices
             # by the round's sample before appending client rows.
             out = finish(new_theta, new_p, new_m, new_duals, new_c,
                          local_loss, em, screened, train_x, train_y, ex,
-                         ey, ew, tidx, tweight, stale_scr)
+                         ey, ew, tidx, tweight, stale_scr, diag)
             if has_stale:
                 return (*out[:5], new_stale, out[5])
             return out
@@ -935,9 +1022,17 @@ class FederatedTrainer:
             local_loss = jnp.where(
                 all_fin, losses.mean(),
                 (lane_loss * fin).sum() / jnp.maximum(fin.sum(), 1))
+            # Compact diagnostics: the m trained lanes' carried
+            # displacement from theta, the fleet dispersion over the
+            # scattered-back full-width state.  Same definitions as the
+            # full-width path up to the lane set (compact-vs-full-width
+            # numerics already differ by summation order).
+            diag = (round_diag(p_keep, start, new_m, new_theta, new_p,
+                               losses, fin)
+                    if diag_on else None)
             return finish(new_theta, new_p, new_m, new_duals, new_c,
                           local_loss, em, 1.0 - fin, train_x, train_y, ex,
-                          ey, ew, tidx, tweight)
+                          ey, ew, tidx, tweight, diag=diag)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
@@ -1939,7 +2034,7 @@ class FederatedTrainer:
             packed = np.asarray(packed)  # ONE device→host fetch per block
             lanes = len(lane_sels[0]) if compact else self.num_workers
             for j, t in enumerate(ts):
-                ll, acc, loss_sum, t_loss, t_acc, scr, _, em = \
+                ll, acc, loss_sum, t_loss, t_acc, scr, _, em, diag = \
                     self._unpack_host_metrics(packed[j], lanes)
                 flags = (scr[:len(sels[j])] if compact else scr[sels[j]])
                 self._apply_screen_feedback(t, sels[j], flags, frows[j])
@@ -1957,8 +2052,12 @@ class FederatedTrainer:
                           if compact
                           else {k_: v[sels[j]] for k_, v in em.items()})
                     self._append_client_rows(t, em, sels[j])
-                self._round_telemetry(t, frows[j])
+                self._round_telemetry(t, frows[j], diag)
                 self.round += 1
+            self._device_telemetry(
+                ts[-1],
+                "compact_fault_block_fn" if fixed_c
+                else "compact_block_fn" if compact else "block_fn", fn)
             done += k
             if next_ckpt is not None and self.round >= next_ckpt:
                 self.save(checkpoint_path)
@@ -2083,7 +2182,7 @@ class FederatedTrainer:
                 (sel, _lim, _cm, frows, _cap,
                  _admit) = self._round_participation(t, frac,
                                                      chosen=chosen[j])
-                ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em = \
+                ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em, diag = \
                     self._unpack_host_metrics(packed[j], w)
                 self._apply_screen_feedback(t, sel, scr[sel], frows)
                 if self._has_stale and sscr is not None:
@@ -2104,8 +2203,10 @@ class FederatedTrainer:
                 if self._holdout:
                     em = {k_: v[sel] for k_, v in em.items()}
                     self._append_client_rows(t, em, sel)
-                self._round_telemetry(t, frows)
+                self._round_telemetry(t, frows, diag)
                 self.round += 1
+            self._device_telemetry(ts[-1], "chaos_block_fn",
+                                   self._chaos_block_fn)
             # The host replay and the device carry apply the same rule
             # to the same flags; drift is a bug, surfaced loudly.
             ok = (np.array_equal(np.asarray(dev_stk),
@@ -2239,7 +2340,7 @@ class FederatedTrainer:
             if self.c_global is not None:
                 self.c_global = new_c
             lanes = len(sel_lanes) if use_c else self.num_workers
-            ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em = \
+            ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em, diag = \
                 self._unpack_host_metrics(
                     np.asarray(packed), lanes)  # ONE device→host fetch/round
             # Compact lanes are survivors-first: the valid prefix holds
@@ -2264,7 +2365,9 @@ class FederatedTrainer:
                 em = ({k_: v[:len(sel)] for k_, v in em.items()} if use_c
                       else {k_: v[sel] for k_, v in em.items()})
                 self._append_client_rows(t, em, sel)
-            self._round_telemetry(t, frows)
+            self._round_telemetry(t, frows, diag)
+            self._device_telemetry(
+                t, "compact_fn" if use_c else "round_fn", step_fn)
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:
                 self.save(checkpoint_path)
@@ -2277,7 +2380,8 @@ class FederatedTrainer:
         f32 vector → (local_loss, test_acc, test_loss_sum, train_loss,
         train_acc, [lanes] screened flags, [lanes]
         screened-on-admission flags (staleness runs; else None), em dict
-        of [lanes, E] arrays or {})."""
+        of [lanes, E] arrays or {}, [6] diagnostics block (diagnostics
+        runs; else None))."""
         ll, acc, loss_sum, t_loss, t_acc = (float(v) for v in vec[:5])
         scr = vec[5:5 + lanes]
         off = 5 + lanes
@@ -2293,7 +2397,8 @@ class FederatedTrainer:
             for i, k in enumerate(("train_loss", "train_acc", "val_acc",
                                    "val_loss")):
                 em[k] = body[i * n:(i + 1) * n].reshape(lanes, e)
-        return ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em
+        diag = vec[-len(self._diag_keys):] if self._diag else None
+        return ll, acc, loss_sum, t_loss, t_acc, scr, sscr, em, diag
 
     def _plan_matrix_for_round(self, t: int) -> np.ndarray:
         return self.faults.plan_matrix_for(t, self._train_matrix)
@@ -2314,11 +2419,12 @@ class FederatedTrainer:
                 )
 
     # -- telemetry (dopt.obs) ------------------------------------------
-    def _round_telemetry(self, t: int, frows: list) -> None:
+    def _round_telemetry(self, t: int, frows: list, diag=None) -> None:
         """Emit round t's telemetry bundle: the fault-ledger rows as
         typed events, the history row just appended as the ``round``
         event, and the host-mirror state (quarantine streaks, the
-        staleness-buffer schedule, the population registry) as
+        staleness-buffer schedule, the population registry) plus the
+        fetched on-device diagnostics block (``diagnostics="on"``) as
         ``gauge`` events.  Everything here derives from the same
         post-fetch host-replay data on every execution path — called
         at the identical point of the per-round, blocked, chaos-blocked
@@ -2335,6 +2441,10 @@ class FederatedTrainer:
             # (dopt.obs.rules): lanes eligible to contribute this round.
             "participating_lanes": float(self.num_workers - quarantined),
         }
+        if diag is not None:
+            from dopt.obs.events import finite_diag_gauges
+
+            gauges.update(finite_diag_gauges(self._diag_keys, diag))
         if self._has_stale:
             gauges["stale_pending"] = float((self._stale_weight > 0).sum())
             gauges["stale_weight_total"] = float(self._stale_weight.sum())
@@ -2351,6 +2461,13 @@ class FederatedTrainer:
         tele.emit_round_bundle(t, engine=self.engine_kind,
                                metrics=self.history.rows[-1],
                                faults=frows, gauges=gauges)
+
+    def _device_telemetry(self, t: int, fn_name: str, fn) -> None:
+        """Non-deterministic resource/compile channel — shared impl in
+        ``dopt.utils.profiling.emit_device_resource``."""
+        from dopt.utils.profiling import emit_device_resource
+
+        emit_device_resource(self, t, fn_name, fn)
 
     def _consensus_value(self) -> float | None:
         """Mean over workers of ‖pᵢ − theta‖₂ from the current device
@@ -2369,9 +2486,15 @@ class FederatedTrainer:
     def _run_summary_telemetry(self) -> None:
         """End-of-``run()`` consensus-distance gauge — one fetch per
         run() call, so per-round and blocked execution of the same call
-        pattern emit the identical event."""
+        pattern emit the identical event.  Suppressed under
+        ``diagnostics="on"``: the diag block already carries the
+        per-round ``lane_dispersion`` (the same mean_i ||p_i − theta||
+        meter) in every round bundle, and the end-of-run gauge is
+        per-``run()``-CALL state — a killed-and-resumed run would emit
+        an extra one mid-stream, breaking the gauges-included canonical
+        equality diagnostics guarantees."""
         tele = self.telemetry
-        if tele is None:
+        if tele is None or self._diag:
             return
         cd = self._consensus_value()
         if cd is not None:

@@ -50,7 +50,8 @@ from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 worker_sharding)
 from dopt.faults import FaultPlan, churn_ledger_rows, corrupt_update
 from dopt.robust import (byzantine_mix, clipped_gossip_mix,
-                         finite_lane_mask, validate_robust_config)
+                         finite_lane_mask, lane_sq_norms,
+                         validate_robust_config)
 from dopt.topology import (MixingMatrices, build_mixing_matrices,
                            coeffs_for_matrix, repair_for_dropout,
                            repair_for_partition,
@@ -320,6 +321,34 @@ class GossipTrainer:
             raise ValueError(
                 f"unknown prefetch {g.prefetch!r}; one of off|on")
         self._prefetch = g.prefetch == "on"
+        # Per-round convergence diagnostics (GossipConfig.diagnostics):
+        # "on" computes the diag scalar block INSIDE the compiled round
+        # (it rides the packed host-metrics vector, so the blocked scan
+        # carries it as one more stacked output) and emits it as
+        # deterministic gauges at the post-fetch boundary, plus the
+        # non-deterministic resource/compile channel when telemetry is
+        # attached.  "off" (default) compiles the exact pre-change
+        # programs — every use below is python-gated on it.
+        if g.diagnostics not in ("off", "on"):
+            raise ValueError(
+                f"unknown diagnostics {g.diagnostics!r}; one of off|on")
+        self._diag = g.diagnostics == "on"
+        from dopt.obs.events import DIAG_GAUGES
+
+        # The packed block's emission names: the shared five + this
+        # engine's dispersion meter (round_diag's stack order).
+        self._diag_keys = DIAG_GAUGES + ("consensus_distance",)
+        from dopt.utils.profiling import CompileWatcher
+
+        self._compile_watch = CompileWatcher()
+        self._last_step_total = 0.0
+        if self._diag and self._registry is not None:
+            raise ValueError(
+                "diagnostics='on' does not compose with population mode "
+                "(lanes rebind to a different client cohort every round, "
+                "so round-over-round lane diagnostics would mix cohort "
+                "resampling noise with actual contraction) — drop one of "
+                "the two")
         if self._prefetch and self._registry is not None:
             raise ValueError(
                 "prefetch='on' does not compose with gossip population "
@@ -755,6 +784,58 @@ class GossipTrainer:
             return ((losses.mean(axis=1) * alive).sum() / denom,
                     (accs.mean(axis=1) * alive).sum() / denom)
 
+        diag_on = self._diag
+        # [W] per-lane squared L2 over a lane-leading pytree — the same
+        # f32-accumulated reduction the robust screen uses.
+        _lane_sq = lane_sq_norms
+
+        def round_diag(p_new, m_new, p_start, losses, alive):
+            """[6] f32 per-round diagnostics (dopt.obs.events.DIAG_GAUGES
+            + consensus_distance), computed ON DEVICE from the round's
+            CARRIED state so per-round and blocked execution can never
+            diverge: global L2 of the round's displacement
+            ||p_new − p_start|| (dead lanes carry their state — zero
+            displacement), of the carried momentum (the velocity — the
+            smoothed-gradient meter), and of the carried params; the
+            lane train-loss mean and max−min spread; and the true
+            per-round consensus distance mean_i ||p_i − p̄||.
+
+            All six reduce over the DIAGNOSABLE lanes: alive AND
+            carrying finite state/loss.  A screened Byzantine liar
+            keeps its poisoned params in its own lane (quarantine is
+            the defense; the aggregation mask is the protection) — one
+            NaN lane must not blind every fleet-health meter, so
+            non-finite lanes drop out of the reductions.  The mask is
+            computed from the same carried data on every execution
+            path, so it is itself deterministic."""
+            upd_sq = _lane_sq(jax.tree.map(
+                lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                p_new, p_start))
+            m_sq = _lane_sq(m_new)
+            p_sq = _lane_sq(p_new)
+            lane = losses.mean(axis=1).astype(jnp.float32)
+            ok = (alive * jnp.isfinite(upd_sq) * jnp.isfinite(m_sq)
+                  * jnp.isfinite(p_sq) * jnp.isfinite(lane))
+            denom = jnp.maximum(ok.sum(), 1.0)
+            upd = jnp.sqrt((jnp.where(ok > 0, upd_sq, 0.0)).sum())
+            gn = jnp.sqrt((jnp.where(ok > 0, m_sq, 0.0)).sum())
+            pn = jnp.sqrt((jnp.where(ok > 0, p_sq, 0.0)).sum())
+            lmean = (jnp.where(ok > 0, lane, 0.0)).sum() / denom
+            lmax = jnp.where(ok > 0, lane, -jnp.inf).max()
+            lmin = jnp.where(ok > 0, lane, jnp.inf).min()
+            spread = jnp.where(ok.sum() > 0, lmax - lmin, 0.0)
+            sq = None
+            for x in jax.tree.leaves(p_new):
+                xf = x.astype(jnp.float32)
+                okx = ok.reshape((-1,) + (1,) * (xf.ndim - 1))
+                xf0 = jnp.where(okx > 0, xf, 0.0)
+                bar = xf0.sum(axis=0) / denom
+                d = (xf0 - bar[None] * okx).reshape(xf.shape[0], -1)
+                s = (d * d).sum(axis=1)
+                sq = s if sq is None else sq + s
+            cd = (jnp.where(ok > 0, jnp.sqrt(sq), 0.0)).sum() / denom
+            return jnp.stack([upd, gn, pn, lmean, spread, cd])
+
         def local_phase(params, mom, idx, bweight, train_x, train_y,
                         vidx, vw, limits):
             """The per-round local-training phase: flat step scan on the
@@ -786,7 +867,7 @@ class GossipTrainer:
                 p_t, m_t, losses, accs = local(params, mom, bx, by, bweight)
             return p_t, m_t, losses, accs, {}
 
-        def pack_host_metrics(tl, ta, evalm, em, screened):
+        def pack_host_metrics(tl, ta, evalm, em, screened, diag=None):
             """Everything the host reads per round, as ONE flat f32
             vector — on this hardware every device→host fetch pays a
             fixed ~100 ms tunnel round-trip, so the round's metrics
@@ -805,6 +886,10 @@ class GossipTrainer:
                 parts += [em["train_loss"].ravel(), em["train_acc"].ravel(),
                           em["val_acc"].ravel(),
                           em["val_loss_mean"].ravel()]
+            if diag_on:
+                # Diagnostics block travels LAST so every earlier
+                # offset (_unpack_host_metrics) is layout-stable.
+                parts.append(diag)
             return jnp.concatenate(
                 [p.astype(jnp.float32) for p in parts])
 
@@ -902,8 +987,12 @@ class GossipTrainer:
                 p_t = where_mask(alive, p_t, params)
                 m_t = where_mask(alive, m_t, mom)
             tl, ta = train_metrics(losses, accs, alive)
+            # ``params`` is the post-consensus state here, so the diag
+            # update norm measures the local-training displacement.
+            diag = (round_diag(p_t, m_t, params, losses, alive)
+                    if diag_on else None)
             return p_t, m_t, x_hat, pack_host_metrics(tl, ta, evalm, em,
-                                                      screened)
+                                                      screened, diag)
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
@@ -977,7 +1066,9 @@ class GossipTrainer:
                     p_t = where_mask(alive_t, p_t, p)
                     m_t = where_mask(alive_t, m_t, m)
                 tl, ta = train_metrics(losses, accs, alive_t)
-                packed = pack_host_metrics(tl, ta, evalm, em, scr)
+                diag = (round_diag(p_t, m_t, p, losses, alive_t)
+                        if diag_on else None)
+                packed = pack_host_metrics(tl, ta, evalm, em, scr, diag)
                 if fused_quar:
                     stk, unt = quarantine_update(stk, unt, scr, alive_t,
                                                  t_t)
@@ -1115,6 +1206,12 @@ class GossipTrainer:
                     p_t = where_mask(alive, p_t, mixed)
                     m_t = where_mask(alive, m_t, mom)
                 tl, ta = train_metrics(losses, accs, alive)
+                # Diagnostics on the DE-BIASED estimates (pre-rebias):
+                # under push-sum the carried numerators scale with mass,
+                # and z = x/mass is the quantity that converges — the
+                # same convention the end-of-run consensus gauge uses.
+                diag = (round_diag(p_t, m_t, mixed, losses, alive)
+                        if diag_on else None)
                 if push_sum:
                     def rebias(zl):
                         mm = mass_out.reshape(
@@ -1124,7 +1221,8 @@ class GossipTrainer:
 
                     p_t = jax.tree.map(rebias, p_t)
                 return (p_t, m_t, mass_out, new_buf, new_buf_mass,
-                        pack_host_metrics(tl, ta, evalm, em, screened))
+                        pack_host_metrics(tl, ta, evalm, em, screened,
+                                          diag))
 
             self._link_round_fn = jax.jit(link_round_core,
                                           donate_argnums=(0, 1, 2, 3, 4))
@@ -1323,7 +1421,7 @@ class GossipTrainer:
                 (self.params, self.momentum, self.x_hat, packed) = out
             packed = np.asarray(packed)  # ONE device→host fetch per block
             for j, t in enumerate(ts):
-                tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
+                tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
                     packed[j])
                 if fused_quar:
                     # Post-fetch ledger replay: host state is now
@@ -1351,7 +1449,8 @@ class GossipTrainer:
                 self.history.append(**row)
                 if self._holdout:
                     self._append_client_rows(t, em)
-                self._round_telemetry(t, rows_j if fused_quar else frows[j])
+                self._round_telemetry(t, rows_j if fused_quar else frows[j],
+                                      diag)
                 self.round += 1
             if fused_quar:
                 # The host replay and the device carry apply the same
@@ -1366,6 +1465,8 @@ class GossipTrainer:
                     raise RuntimeError(
                         "fused-quarantine host replay diverged from the "
                         "device scan carry")
+            self._device_telemetry(
+                ts[-1], "link_block_fn" if link else "block_fn", fn)
             done += k
             if next_ckpt is not None and self.round >= next_ckpt:
                 self.save(checkpoint_path)
@@ -1377,7 +1478,8 @@ class GossipTrainer:
         """Inverse of the round step's ``pack_host_metrics``: one fetched
         f32 vector → (train_loss, train_acc, mean_test_acc,
         mean_test_loss, [W] screened flags (robust runs; else None), em
-        dict of [W, E] arrays or {})."""
+        dict of [W, E] arrays or {}, [6] diagnostics block
+        (diagnostics runs; else None))."""
         tl, ta, acc, lm = (float(vec[0]), float(vec[1]), float(vec[2]),
                            float(vec[3]))
         off = 4
@@ -1393,7 +1495,8 @@ class GossipTrainer:
             for i, k in enumerate(("train_loss", "train_acc", "val_acc",
                                    "val_loss")):
                 em[k] = body[i * n:(i + 1) * n].reshape(w, e)
-        return tl, ta, acc, lm, scr, em
+        diag = vec[-len(self._diag_keys):] if self._diag else None
+        return tl, ta, acc, lm, scr, em, diag
 
     def _append_client_rows(self, t: int, em: dict) -> None:
         """Per-epoch per-worker history rows (P2 Client.history schema,
@@ -1411,12 +1514,13 @@ class GossipTrainer:
                 )
 
     # -- telemetry (dopt.obs) ------------------------------------------
-    def _round_telemetry(self, t: int, frows: list) -> None:
+    def _round_telemetry(self, t: int, frows: list, diag=None) -> None:
         """Emit round t's telemetry bundle: the fault-ledger rows as
         typed events, the history row just appended as the ``round``
         event, and the host-mirror state (quarantine streaks, the
-        population registry) as ``gauge`` events.  Derived only from
-        post-fetch host-replay data at the identical point of the
+        population registry) plus the fetched on-device diagnostics
+        block (``diagnostics="on"``) as ``gauge`` events.  Derived only
+        from post-fetch host-replay data at the identical point of the
         per-round and blocked loops, so the streams are bit-identical
         across execution paths; ``telemetry=None`` skips it."""
         tele = self.telemetry
@@ -1430,6 +1534,10 @@ class GossipTrainer:
             # (dopt.obs.rules): lanes eligible to contribute this round.
             "participating_lanes": float(self.num_workers - quarantined),
         }
+        if diag is not None:
+            from dopt.obs.events import finite_diag_gauges
+
+            gauges.update(finite_diag_gauges(self._diag_keys, diag))
         if self._registry is not None:
             reg = self._registry
             gauges["cohort_size"] = float(reg.cohort_size)
@@ -1443,6 +1551,13 @@ class GossipTrainer:
         tele.emit_round_bundle(t, engine=self.engine_kind,
                                metrics=self.history.rows[-1],
                                faults=frows, gauges=gauges)
+
+    def _device_telemetry(self, t: int, fn_name: str, fn) -> None:
+        """Non-deterministic resource/compile channel — shared impl in
+        ``dopt.utils.profiling.emit_device_resource``."""
+        from dopt.utils.profiling import emit_device_resource
+
+        emit_device_resource(self, t, fn_name, fn)
 
     def _consensus_value(self) -> float | None:
         """Mean over workers of ‖xᵢ − x̄‖₂ on the de-biased estimates
@@ -1461,9 +1576,14 @@ class GossipTrainer:
     def _run_summary_telemetry(self) -> None:
         """End-of-``run()`` consensus-distance gauge — one fetch per
         run() call; identical across execution paths for an identical
-        call pattern."""
+        call pattern.  Suppressed under ``diagnostics="on"``: the diag
+        block already carries a TRUE per-round consensus distance in
+        every round bundle (watermark-suppressed on resume), and the
+        end-of-run gauge is per-``run()``-CALL state — a killed-and-
+        resumed run would emit an extra one mid-stream, breaking the
+        gauges-included canonical equality diagnostics guarantees."""
         tele = self.telemetry
-        if tele is None:
+        if tele is None or self._diag:
             return
         cd = self._consensus_value()
         if cd is not None:
@@ -1738,7 +1858,7 @@ class GossipTrainer:
                     self._train_x, self._train_y, *self._eval, *self._val,
                     do_eval, **step_kw,
                 )
-            tl, ta, acc, lm, scr, em = self._unpack_host_metrics(
+            tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
                 np.asarray(packed))  # ONE device→host fetch per round
             if self._robust_active:
                 alive_eff = (alive * (1.0 - quar) if self._fused_quar
@@ -1756,7 +1876,10 @@ class GossipTrainer:
             self.history.append(**row)
             if self._holdout:
                 self._append_client_rows(t, em)
-            self._round_telemetry(t, frows)
+            self._round_telemetry(t, frows, diag)
+            self._device_telemetry(
+                t, "link_round_fn" if self._link_mode else "round_fn",
+                self._link_round_fn if self._link_mode else self._round_fn)
             self.round += 1
             if (checkpoint_every and
                     self.round % checkpoint_every == 0):

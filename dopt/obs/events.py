@@ -41,6 +41,21 @@ a ``kind``, and a wall-clock ``ts``.  The kinds:
              checkpoint-cadence and opt-in consensus-stall rules; NOT
              deterministic — blocked execution checkpoints at block
              boundaries.
+``resource`` a device-resource occupancy sample (``diagnostics="on"``):
+             ``peak_bytes`` / ``live_bytes`` from the backend allocator
+             (``dopt.utils.profiling.device_memory_stats`` — host RSS
+             on backends without memory stats, marked by ``source``),
+             taken per block at the post-fetch boundary.  NOT
+             deterministic — sampling cadence is an execution-path
+             property (per-round paths sample every round, blocked
+             paths every block), so like ``alert``/``checkpoint`` it
+             stays outside ``DETERMINISTIC_KINDS``.
+``compile``  a (re)trace of a compiled round function (``fn``,
+             ``count`` new cache entries, ``total`` cache size,
+             ``seconds`` — the dispatch wall that absorbed the
+             compile, an upper bound).  NOT deterministic: the
+             per-round and blocked paths trace different programs at
+             different times; the retrace-storm rule consumes it.
 
 The v1 schema evolves additively: new kinds and new optional fields
 appear under the same ``v`` (consumers ignore unknown kinds/keys);
@@ -65,7 +80,7 @@ from typing import Any, Iterable
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning",
-         "alert", "checkpoint")
+         "alert", "checkpoint", "resource", "compile")
 
 ALERT_SEVERITIES = ("warn", "critical")
 
@@ -73,6 +88,28 @@ ALERT_SEVERITIES = ("warn", "critical")
 # data: streams filtered to these (ts dropped) are bit-identical across
 # per-round / blocked / resumed execution of the same config.
 DETERMINISTIC_KINDS = ("round", "fault", "gauge")
+
+# The per-round convergence diagnostics the engines emit as gauges with
+# ``diagnostics="on"`` (dopt.config), in packed order.  The sixth gauge
+# is the engine's dispersion meter: ``consensus_distance`` (gossip —
+# mean_i ||p_i - p_bar||) or ``lane_dispersion`` (federated —
+# mean_i ||p_i - theta||).  All six are DETERMINISTIC (computed inside
+# the compiled round from the same data on every execution path).
+DIAG_GAUGES = ("update_norm", "grad_norm", "param_norm",
+               "lane_loss_mean", "lane_loss_spread")
+
+
+def finite_diag_gauges(keys: Iterable[str], block) -> dict[str, float]:
+    """Zip a fetched diagnostics block into a gauge dict, dropping
+    non-finite values: a diverged fleet's norms go NaN/Inf, gauge
+    values must stay finite (schema) — absent beats unparsable, and
+    finiteness is itself deterministic across execution paths."""
+    out: dict[str, float] = {}
+    for name, value in zip(keys, block):
+        v = float(value)
+        if math.isfinite(v):
+            out[name] = v
+    return out
 
 
 def make_event(kind: str, **fields: Any) -> dict[str, Any]:
@@ -203,6 +240,26 @@ def validate_event(ev: Any) -> dict[str, Any]:
             v = ev["consensus_distance"]
             if not _is_num(v) or not math.isfinite(v):
                 _fail("checkpoint consensus_distance must be finite", ev)
+    elif kind == "resource":
+        _req_int(ev, "round")
+        v = ev.get("peak_bytes")
+        if not _is_num(v) or not math.isfinite(v) or v < 0:
+            _fail("resource event needs finite peak_bytes >= 0", ev)
+        if "live_bytes" in ev:
+            v = ev["live_bytes"]
+            if not _is_num(v) or not math.isfinite(v) or v < 0:
+                _fail("resource live_bytes must be finite >= 0", ev)
+        if "source" in ev:
+            _req_str(ev, "source")
+    elif kind == "compile":
+        _req_int(ev, "round")
+        _req_str(ev, "fn")
+        _req_int(ev, "count", lo=1)
+        if "total" in ev:
+            _req_int(ev, "total", lo=1)
+        v = ev.get("seconds")
+        if not _is_num(v) or not math.isfinite(v) or v < 0:
+            _fail("compile event needs finite seconds >= 0", ev)
     return ev
 
 

@@ -2,9 +2,11 @@
 
 ``results/bench_history.jsonl`` is an append-only ledger: every
 bench.py headline JSON line lands as one entry stamped with the git sha
-and a run id (``append_entry``), so the r01→r05 trajectory the
-committed ``BENCH_r*.json`` files hold becomes data a regressor can
-watch — per run, not per postmortem.
+and a run id (``append_entry`` — deduped on ``run_id``, so a re-run
+replaces its prior entry instead of stacking duplicates that skew the
+trailing trimmed median), so the r01→r05 trajectory the committed
+``BENCH_r*.json`` files hold becomes data a regressor can watch — per
+run, not per postmortem.
 
 ``check_regression`` compares a candidate entry against the trailing
 window of earlier entries with the same ``(metric, device_kind)`` key
@@ -93,12 +95,51 @@ def append_entry(path: str | Path, headline: dict[str, Any], *,
                  run_id: str | None = None, sha: str | None = None,
                  ts: float | None = None) -> dict[str, Any]:
     """Append one headline to the ledger (sha auto-detected when not
-    given); returns the entry written."""
+    given); returns the entry written.
+
+    DEDUPED on ``run_id``: a re-run at the same run id REPLACES its
+    prior entry (the ledger is atomically rewritten without the
+    duplicates) instead of stacking copies — N retries of one run would
+    otherwise occupy N slots of the trailing window and drag the
+    trimmed median toward that single run's value.  Fresh run ids take
+    the plain-append fast path.
+
+    The pre-append scan parses TOLERANTLY (unlike ``read_ledger``'s
+    strict contract): the plain-append path is not atomic, so a crash
+    mid-write can leave a torn final line — a strict read here would
+    make every future append raise until the ledger is hand-repaired.
+    Any torn line triggers the atomic-rewrite (repair) path, which
+    drops it: the ledger stays ``read_ledger``-clean, so the
+    regressor CLI keeps working after a crash."""
     if sha is None:
         sha = git_sha(Path(path).resolve().parent)
     entry = make_entry(headline, run_id=run_id, sha=sha, ts=ts)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    if path.exists():
+        torn = False
+        existing = []
+        for line in path.read_text().splitlines():
+            try:
+                e = json.loads(line)
+            except ValueError:
+                torn = True
+                continue
+            if isinstance(e, dict):
+                existing.append(e)
+            else:
+                torn = True
+        if torn or any(e.get("run_id") == entry["run_id"]
+                       for e in existing):
+            from dopt.utils.metrics import atomic_write_text
+
+            kept = [e for e in existing
+                    if e.get("run_id") != entry["run_id"]]
+            kept.append(entry)
+            atomic_write_text(path, "".join(
+                json.dumps(e, separators=(",", ":")) + "\n"
+                for e in kept))
+            return entry
     with open(path, "a") as f:
         f.write(json.dumps(entry, separators=(",", ":")) + "\n")
     return entry

@@ -184,9 +184,13 @@ class ConsensusStallRule(Rule):
     relative — mixing is not contracting (partitioned topology,
     mis-weighted matrix, or adversaries pulling the fleet apart).
 
-    Observation sources: the ``consensus_distance`` gauge (the engines
-    emit it once per ``run()`` call — a service driving a trainer in
-    chunks accumulates one per chunk), and — with
+    Observation sources: the ``consensus_distance`` gauge — with
+    ``diagnostics="on"`` the gossip engine emits a TRUE per-round one
+    inside every round bundle (the rule finally gets more than one
+    observation per ``run()`` call), otherwise the engines emit one
+    per ``run()`` call — the federated engine's per-round
+    ``lane_dispersion`` gauge (its diagnostics-mode dispersion meter;
+    a stream carries one of the two names, never both) — and, with
     ``use_checkpoints=True`` — the ``consensus_distance`` field each
     ``checkpoint`` event carries (one per save, so a long soak with
     ``--checkpoint-every K`` observes every K rounds).  The checkpoint
@@ -209,7 +213,12 @@ class ConsensusStallRule(Rule):
 
     def update(self, ev: dict, ctx: RunContext) -> list[dict]:
         kind = ev.get("kind")
-        if kind == "gauge" and ev.get("name") == "consensus_distance":
+        # lane_dispersion is the federated engine's dispersion meter
+        # (mean_i ||p_i - theta||, diagnostics="on") — the same
+        # is-the-fleet-contracting signal under another name; a stream
+        # only ever carries one of the two, so one window serves both.
+        if kind == "gauge" and ev.get("name") in ("consensus_distance",
+                                                  "lane_dispersion"):
             v = ev["value"]
         elif (kind == "checkpoint" and self.use_checkpoints
               and isinstance(ev.get("consensus_distance"), (int, float))):
@@ -228,6 +237,170 @@ class ConsensusStallRule(Rule):
                                 f"{hist[0]:.4g} -> {hist[-1]:.4g} over "
                                 f"{self.patience + 1} observations "
                                 "(mixing is not contracting)"}]
+        return []
+
+
+class GradExplosionRule(Rule):
+    """A convergence-diagnostic norm gauge (``grad_norm`` — the carried
+    momentum/velocity — or ``update_norm``, the round's parameter
+    displacement; both emitted per round by ``diagnostics="on"``) blew
+    past ``factor`` × its trailing-window median plus the absolute
+    ``min_delta`` guard: gradients are exploding, usually rounds before
+    the loss shows it (the loss_divergence rule's trailing median needs
+    the damage to reach the objective first).  Reads only ``gauge``
+    events — deterministic, so the alert sequence stays identical
+    across execution paths.  Each watched gauge keeps its own window
+    and edge state."""
+
+    name = "grad_explosion"
+    severity = "critical"
+
+    def __init__(self, window: int = 8, factor: float = 10.0,
+                 min_delta: float = 1.0, min_history: int = 3,
+                 gauges: tuple[str, ...] = ("grad_norm", "update_norm")):
+        self.window = int(window)
+        self.factor = float(factor)
+        self.min_delta = float(min_delta)
+        self.min_history = int(min_history)
+        self.gauges = tuple(gauges)
+        super().__init__()
+
+    def reset(self) -> None:
+        self.s = {"armed": {}, "hist": {}}
+
+    def _edge_key(self, key: str, violated: bool) -> bool:
+        armed = self.s["armed"]
+        if violated and armed.get(key, True):
+            armed[key] = False
+            return True
+        if not violated:
+            armed[key] = True
+        return False
+
+    def update(self, ev: dict, ctx: RunContext) -> list[dict]:
+        if ev.get("kind") != "gauge" or ev.get("name") not in self.gauges:
+            return []
+        name = str(ev["name"])
+        v = float(ev["value"])
+        hist = self.s["hist"].setdefault(name, [])
+        out: list[dict] = []
+        if len(hist) >= self.min_history:
+            med = statistics.median(hist)
+            bar = self.factor * med + self.min_delta
+            if self._edge_key(name, v > bar):
+                out.append({"round": ev["round"], "value": v,
+                            "message": f"{name}={v:.4g} at round "
+                                       f"{ev['round']} exceeds "
+                                       f"{self.factor}x trailing median "
+                                       f"({med:.4g}) — gradient "
+                                       "explosion"})
+        hist.append(v)
+        del hist[:-self.window]
+        return out
+
+
+class RetraceStormRule(Rule):
+    """The compiled round functions are retracing as the run goes: a
+    ``compile`` event (``diagnostics="on"`` emits one whenever a round
+    function's trace cache grew) landed at more than ``max_rounds``
+    DISTINCT rounds inside the trailing ``window`` rounds.  Healthy
+    runs compile each round program once at warmup (1-2 distinct
+    rounds); a compile per round means a shape/dtype is leaking into
+    the trace (survivor counts as shapes, a drifting remainder block)
+    and every round pays seconds of XLA time.  ``compile`` is a
+    NON-deterministic kind, so like checkpoint_cadence this rule trades
+    the hard cross-execution-path alert-identity guarantee for the
+    signal; to keep healthy paths IDENTICAL in practice the window is
+    SEGMENT-scoped — every ``run`` header (resume continuations
+    included) clears it, so a killed-and-resumed run's second warmup
+    reads as a fresh segment's warmup, not as half a storm."""
+
+    name = "retrace_storm"
+    severity = "warn"
+
+    def __init__(self, window: int = 8, max_rounds: int = 3):
+        self.window = int(window)
+        self.max_rounds = int(max_rounds)
+        super().__init__()
+
+    def reset(self) -> None:
+        self.s = {"armed": True, "rounds": []}
+
+    def update(self, ev: dict, ctx: RunContext) -> list[dict]:
+        if ev.get("kind") == "run":
+            # The monitor only resets rules on round-0 headers; this
+            # rule's window is meaningless across a process restart, so
+            # it also clears on resume CONTINUATION headers.
+            self.reset()
+            return []
+        if ev.get("kind") != "compile":
+            return []
+        t = int(ev["round"])
+        rounds = self.s["rounds"]
+        if t not in rounds:
+            rounds.append(t)
+        self.s["rounds"] = rounds = [r for r in rounds
+                                     if r > t - self.window]
+        if self.edge(len(rounds) > self.max_rounds):
+            return [{"round": t, "value": float(len(rounds)),
+                     "message": f"compiled round functions retraced at "
+                                f"{len(rounds)} distinct rounds within "
+                                f"the last {self.window} (fn "
+                                f"{ev.get('fn')!r}) — a shape/dtype is "
+                                "leaking into the trace"}]
+        return []
+
+
+class HbmGrowthRule(Rule):
+    """Device (or host-RSS fallback) LIVE memory is rising across
+    ``patience``+1 consecutive ``resource`` samples by more than
+    ``tol`` relative AND ``min_bytes`` absolute — the leak shape: a
+    per-block allocation that never frees (e.g. an accumulating host
+    mirror, an unbounded trace cache).  Warmup allocation noise does
+    not satisfy strictly-monotonic growth over five samples plus both
+    margins.  ``resource`` is a NON-deterministic kind (per-block
+    sampling cadence), so like retrace_storm this rule is outside the
+    hard alert-identity guarantee; its window is likewise
+    SEGMENT-scoped (any ``run`` header clears it — occupancy samples
+    are not comparable across a process restart), keeping healthy
+    paths identical in practice."""
+
+    name = "hbm_growth"
+    severity = "warn"
+
+    def __init__(self, patience: int = 4, tol: float = 0.5,
+                 min_bytes: int = 64 << 20):
+        self.patience = int(patience)
+        self.tol = float(tol)
+        self.min_bytes = int(min_bytes)
+        super().__init__()
+
+    def reset(self) -> None:
+        self.s = {"armed": True, "hist": []}
+
+    def update(self, ev: dict, ctx: RunContext) -> list[dict]:
+        if ev.get("kind") == "run":
+            self.reset()
+            return []
+        if ev.get("kind") != "resource":
+            return []
+        v = ev.get("live_bytes", ev.get("peak_bytes"))
+        if not isinstance(v, (int, float)):
+            return []
+        hist = self.s["hist"]
+        hist.append(float(v))
+        del hist[:-(self.patience + 1)]
+        rising = (len(hist) == self.patience + 1
+                  and all(b > a for a, b in zip(hist, hist[1:]))
+                  and hist[-1] > hist[0] * (1.0 + self.tol)
+                  and hist[-1] - hist[0] > self.min_bytes)
+        if self.edge(rising):
+            return [{"round": ev["round"], "value": hist[-1],
+                     "message": f"live device memory rose "
+                                f"{hist[0] / 2**20:.0f} -> "
+                                f"{hist[-1] / 2**20:.0f} MiB over "
+                                f"{self.patience + 1} consecutive "
+                                "samples (leak shape)"}]
         return []
 
 
@@ -418,6 +591,7 @@ class CheckpointCadenceRule(Rule):
 RULES: dict[str, type[Rule]] = {
     cls.name: cls for cls in (
         NonFiniteLossRule, LossDivergenceRule, ConsensusStallRule,
+        GradExplosionRule, RetraceStormRule, HbmGrowthRule,
         QuarantineStormRule, DropRateRule, StalenessSaturationRule,
         HostGapRule, CheckpointCadenceRule,
     )

@@ -228,6 +228,7 @@ class PrometheusSink(Sink):
         self._gauges: dict[str, tuple[str, dict[str | None, float]]] = {}
         self._faults: dict[str, int] = {}
         self._alerts: dict[tuple[str, str], int] = {}
+        self._compiles: dict[str, int] = {}
 
     def _set(self, name: str, help_: str, engine: str | None,
              value: float) -> None:
@@ -254,6 +255,21 @@ class PrometheusSink(Sink):
         elif kind == "alert":
             key = (str(event["rule"]), str(event.get("severity", "warn")))
             self._alerts[key] = self._alerts.get(key, 0) + 1
+        elif kind == "resource":
+            # Device-resource samples (diagnostics="on"): latest HBM/RSS
+            # occupancy as gauges, like the round metrics.
+            eng = event.get("engine")
+            for key in ("live_bytes", "peak_bytes"):
+                v = event.get(key)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self._set(f"hbm_{key}",
+                              f"latest device-memory {key} sample "
+                              "(resource events)", eng, float(v))
+        elif kind == "compile":
+            fn = str(event.get("fn", "?"))
+            c = event.get("count")
+            self._compiles[fn] = self._compiles.get(fn, 0) + (
+                int(c) if isinstance(c, int) else 1)
 
     def render(self) -> str:
         lines = []
@@ -282,6 +298,14 @@ class PrometheusSink(Sink):
                     f'dopt_alerts_total{{rule="{_label_value(rule)}",'
                     f'severity="{_label_value(sev)}"}} '
                     f'{self._alerts[(rule, sev)]}')
+        if self._compiles:
+            lines.append("# HELP dopt_compiles_total round-function "
+                         "(re)trace events observed, by function")
+            lines.append("# TYPE dopt_compiles_total counter")
+            for fn in sorted(self._compiles):
+                lines.append(
+                    f'dopt_compiles_total{{fn="{_label_value(fn)}"}} '
+                    f'{self._compiles[fn]}')
         return "\n".join(lines) + "\n"
 
     def write(self, path: str | Path | None = None) -> Path:

@@ -30,10 +30,17 @@ _RATE_WINDOW = 32
 
 
 class WatchState:
-    """Incremental reduction of the event stream into one screenful."""
+    """Incremental reduction of the event stream into one screenful.
 
-    def __init__(self, monitor: HealthMonitor):
+    ``gauge_filter`` (a set of gauge names, or None) narrows the gauge
+    line; by DEFAULT every gauge in the stream renders — new producer
+    gauges (the ``diagnostics="on"`` convergence block, future
+    engines') surface without a code edit here."""
+
+    def __init__(self, monitor: HealthMonitor,
+                 gauge_filter: set[str] | None = None):
         self.monitor = monitor
+        self.gauge_filter = gauge_filter
         self.tail: JsonlTail | None = None
         self.run: dict[str, Any] | None = None
         self.round: int | None = None
@@ -43,6 +50,8 @@ class WatchState:
         self.gauges: dict[str, float] = {}
         self.faults: dict[str, int] = {}
         self.phases: dict[str, float] | None = None
+        self.resource: dict[str, Any] | None = None
+        self.compiles = 0
         self.events = 0
         # Alerts EMBEDDED in the stream (a producer-side monitor wrote
         # them) — kept separate from self.monitor's own firings, which
@@ -82,6 +91,10 @@ class WatchState:
                 self.faults[f] = self.faults.get(f, 0) + 1
             elif kind == "phase":
                 self.phases = ev.get("fractions")
+            elif kind == "resource":
+                self.resource = ev
+            elif kind == "compile":
+                self.compiles += 1
             elif kind == "alert":
                 self.stream_alerts.append(ev)
         return fired
@@ -125,13 +138,27 @@ class WatchState:
             + (f" | {self.loss_key}={self.loss:.5g}"
                if self.loss is not None and self.loss_key else
                (f" | {self.loss_key}=non-finite" if self.loss_key else "")))
-        shown = {k: v for k, v in self.gauges.items()
-                 if k in ("quarantine_active", "stale_pending",
-                          "consensus_distance", "cohort_size",
-                          "participating_lanes", "host_gap_pct")}
+        # ALL gauges render by default (sorted, %g-formatted) so new
+        # producer gauges — the diagnostics="on" convergence block
+        # included — surface without a code edit; --gauges narrows.
+        shown = self.gauges
+        if self.gauge_filter is not None:
+            shown = {k: v for k, v in shown.items()
+                     if k in self.gauge_filter}
         if shown:
             lines.append("  gauges  " + "  ".join(
                 f"{k}={v:g}" for k, v in sorted(shown.items())))
+        if self.resource is not None:
+            peak = self.resource.get("peak_bytes")
+            live = self.resource.get("live_bytes")
+            bits = [f"peak={peak / 2**30:.2f}GiB"
+                    if isinstance(peak, (int, float)) else None,
+                    f"live={live / 2**30:.2f}GiB"
+                    if isinstance(live, (int, float)) else None,
+                    (f"({self.resource.get('source')})"
+                     if self.resource.get("source") else None),
+                    f"compiles={self.compiles}" if self.compiles else None]
+            lines.append("  memory  " + "  ".join(b for b in bits if b))
         if self.faults:
             lines.append("  faults  " + "  ".join(
                 f"{k}={v}" for k, v in sorted(self.faults.items())))
@@ -165,10 +192,16 @@ def main(argv: list[str] | None = None) -> int:
                          "place (for dumb terminals / logs)")
     ap.add_argument("--workers", type=int, default=None,
                     help="fleet-size denominator override for rules")
+    ap.add_argument("--gauges", default=None, metavar="NAME[,NAME...]",
+                    help="show only these gauges (comma-separated); "
+                         "default shows every gauge in the stream")
     args = ap.parse_args(argv)
 
     monitor = HealthMonitor(workers=args.workers)
-    state = WatchState(monitor)
+    gauge_filter = (set(g.strip() for g in args.gauges.split(",")
+                        if g.strip())
+                    if args.gauges else None)
+    state = WatchState(monitor, gauge_filter=gauge_filter)
     try:
         while True:
             fired = state.poll(args.metrics)

@@ -88,13 +88,20 @@ def finite_lane_mask(stacked):
     return functools.reduce(operator.and_, flags).astype(jnp.float32)
 
 
-def _lane_sq_norms(stacked):
+def lane_sq_norms(stacked):
     """[W] float32 squared L2 norm of each lane across all leaves."""
     parts = [
         (leaf.astype(jnp.float32) ** 2).reshape(leaf.shape[0], -1).sum(axis=1)
         for leaf in jax.tree.leaves(stacked)
     ]
     return functools.reduce(operator.add, parts)
+
+
+def global_norm_f32(tree):
+    """Global L2 norm of a pytree, f32-accumulated."""
+    parts = [(leaf.astype(jnp.float32) ** 2).sum()
+             for leaf in jax.tree.leaves(tree)]
+    return jnp.sqrt(functools.reduce(operator.add, parts))
 
 
 def clip_to_ball(stacked, center, radius: float):
@@ -104,7 +111,7 @@ def clip_to_ball(stacked, center, radius: float):
     scales its update.  ``radius=0`` is the caller's 'off' sentinel —
     do not call with it."""
     dev = jax.tree.map(lambda x, c: x - c, stacked, center)
-    n = jnp.sqrt(jnp.maximum(_lane_sq_norms(dev), 1e-24))
+    n = jnp.sqrt(jnp.maximum(lane_sq_norms(dev), 1e-24))
     s = jnp.minimum(1.0, radius / n)                      # [W]
     s = jnp.where(jnp.isfinite(s), s, 0.0)
 

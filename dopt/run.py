@@ -179,6 +179,16 @@ def main(argv: list[str] | None = None) -> int:
                          "spans (batch planning, fused block dispatches, "
                          "checkpoint writes) here — the host-side "
                          "companion to the XLA trace from --trace")
+    ap.add_argument("--diagnostics", choices=("off", "on"), default=None,
+                    help="per-round on-device convergence diagnostics "
+                         "(GossipConfig/FederatedConfig.diagnostics): "
+                         "'on' emits update/grad/param norms, lane-loss "
+                         "spread and the per-round consensus distance / "
+                         "lane dispersion as deterministic gauges, plus "
+                         "HBM 'resource' samples and 'compile' retrace "
+                         "events, into --metrics-out; default keeps the "
+                         "preset's setting ('off' = the exact pre-change "
+                         "programs)")
     ap.add_argument("--timers", action="store_true",
                     help="print phase-timer report")
     ap.add_argument("--trace", default=None, metavar="DIR",
@@ -264,6 +274,18 @@ def main(argv: list[str] | None = None) -> int:
         # the classic worker==lane experiment.
         raise SystemExit("the client population registry is supported by "
                          "the federated/gossip jax engines only")
+    if args.diagnostics is not None:
+        if cfg.gossip is not None:
+            cfg = cfg.replace(gossip=dataclasses.replace(
+                cfg.gossip, diagnostics=args.diagnostics))
+        elif cfg.federated is not None:
+            cfg = cfg.replace(federated=dataclasses.replace(
+                cfg.federated, diagnostics=args.diagnostics))
+        else:
+            # Same contract as --faults/--metrics-out: the torch oracle
+            # and seqlm engines carry no diagnostics layer.
+            raise SystemExit("--diagnostics is supported by the "
+                             "federated/gossip jax engines only")
     if args.num_users is not None:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data,
                                                    num_users=args.num_users))

@@ -277,6 +277,110 @@ def device_stats_of(fn, *, trace_prefix: str = "dopt-devtime-",
         shutil.rmtree(td, ignore_errors=True)
 
 
+def device_memory_stats(device=None) -> dict | None:
+    """Device-memory occupancy snapshot: ``{live_bytes, peak_bytes,
+    source}``.
+
+    Uses the backend allocator's stats where the runtime exposes them
+    (TPU/GPU ``Device.memory_stats``: ``bytes_in_use`` /
+    ``peak_bytes_in_use`` — ``source="device"``); on backends without
+    them (CPU jax returns None) falls back to the PROCESS resident set
+    (live = current RSS from ``/proc/self/statm``, peak =
+    ``ru_maxrss`` — ``source="host_rss"``), so callers always get a
+    finite occupancy signal to report/alert on.  Returns None only when
+    even the host fallback is unavailable.  This is the shared helper
+    behind ``scripts/bench_seqlm.py``'s peak-HBM column, bench.py's
+    ``hbm_peak_gb`` field and the engines' ``resource`` telemetry
+    events (``diagnostics="on"``)."""
+    if device is None:
+        devs = jax.local_devices()
+        device = devs[0] if devs else None
+    stats = None
+    if device is not None:
+        stats = getattr(device, "memory_stats", lambda: None)()
+    if stats and stats.get("peak_bytes_in_use") is not None:
+        return {"live_bytes": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes": int(stats["peak_bytes_in_use"]),
+                "source": "device"}
+    try:
+        import os
+        import resource
+
+        # Linux ru_maxrss is KiB (macOS reports bytes; this repo's
+        # runtime surface is Linux — documented, not branched).
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        try:
+            with open("/proc/self/statm") as f:
+                live = int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, ValueError, IndexError):
+            live = peak
+        return {"live_bytes": int(live), "peak_bytes": int(peak),
+                "source": "host_rss"}
+    except Exception:  # pragma: no cover - non-POSIX fallback
+        return None
+
+
+def emit_device_resource(trainer, t: int, fn_name: str, fn) -> None:
+    """The NON-deterministic device-resource channel, shared by both
+    engines (``diagnostics="on"`` + telemetry attached): an HBM/RSS
+    occupancy sample per block at the post-fetch boundary
+    (``resource``) and a ``compile`` event whenever the dispatched
+    round function (re)traced since the last block.  Both kinds stay
+    outside ``DETERMINISTIC_KINDS`` — sampling cadence is an
+    execution-path property, like ``alert``/``checkpoint`` — so a
+    diagnosed stream still compares canonically equal across paths.
+
+    Reads/advances the trainer's ``_last_step_total`` watermark over
+    its ``round_step`` phase-timer total (the dispatch wall that
+    absorbed any compile — an upper bound on compile seconds) and its
+    ``_compile_watch`` trace-cache watermark."""
+    tele = trainer.telemetry
+    if tele is None or not trainer._diag:
+        return
+    step_total = trainer.timers.totals.get("round_step", 0.0)
+    seconds = max(step_total - trainer._last_step_total, 0.0)
+    trainer._last_step_total = step_total
+    comp = trainer._compile_watch.observe(fn_name, fn)
+    if comp is not None:
+        tele.emit("compile", round=int(t), fn=fn_name,
+                  count=comp["count"], total=comp["total"],
+                  seconds=round(seconds, 6))
+    stats = device_memory_stats()
+    if stats is not None:
+        tele.emit("resource", round=int(t), engine=trainer.engine_kind,
+                  **stats)
+
+
+class CompileWatcher:
+    """Retrace detector for jitted round functions.
+
+    ``observe(name, fn)`` snapshots ``fn``'s trace-cache size and
+    returns ``{"count": new_entries, "total": size}`` when the cache
+    GREW since the previous observation of ``name`` — i.e. the last
+    dispatch (re)traced — else None.  A healthy blocked run compiles
+    each round function once at warmup; a compile event on every
+    observation is the retrace storm the ``retrace_storm`` health rule
+    (dopt.obs.rules) alerts on.  Tolerant of jit wrappers without
+    ``_cache_size`` (returns None — no signal rather than a crash)."""
+
+    def __init__(self) -> None:
+        self._seen: dict[str, int] = {}
+
+    def observe(self, name: str, fn) -> dict | None:
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            return None
+        try:
+            n = int(size())
+        except Exception:
+            return None
+        prev = self._seen.get(name, 0)
+        self._seen[name] = n
+        if n > prev:
+            return {"count": n - prev, "total": n}
+        return None
+
+
 def device_time_of(fn, *, trace_prefix: str = "dopt-devtime-",
                    telemetry=None) -> float:
     """Run ``fn()`` under a profiler trace and return the device self

@@ -81,9 +81,16 @@ def run_point(args) -> int:
         # Back-compat alias for pre-schema consumers of this script.
         "device": str(jax.devices()[0].device_kind),
     }
-    stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
-    if stats and stats.get("peak_bytes_in_use"):
-        out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 3)
+    # Shared occupancy helper (dopt.utils.profiling.device_memory_stats:
+    # backend allocator stats on TPU/GPU, host-RSS fallback on CPU) —
+    # the same peak-HBM column bench.py's headline line carries, so the
+    # seqlm line is always comparable and always present.
+    from dopt.utils.profiling import device_memory_stats
+
+    mem = device_memory_stats()
+    if mem is not None:
+        out["peak_hbm_gb"] = round(mem["peak_bytes"] / 2**30, 3)
+        out["hbm_source"] = mem["source"]
     print(json.dumps(out))
     return 0
 

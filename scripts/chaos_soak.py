@@ -90,6 +90,10 @@ def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig]:
 
 def build_cfg(engine: str, seed: int, rounds: int,
               prefetch: bool = False) -> ExperimentConfig:
+    # diagnostics="on" everywhere: the soak's canonical-stream equality
+    # invariants (per-round vs fused-blocked vs killed-and-resumed)
+    # thereby pin the NEW per-round convergence gauges too — the PR 8/10
+    # guarantee extended to the diagnostics layer.
     pf = "on" if prefetch else "off"
     gossip_fc, fed_fc = cocktail(seed)
     if engine == "gossip":
@@ -99,7 +103,8 @@ def build_cfg(engine: str, seed: int, rounds: int,
             gossip=GossipConfig(algorithm="dsgd", topology="circle",
                                 mode="metropolis", rounds=rounds,
                                 local_ep=1, local_bs=32,
-                                correction="push_sum", prefetch=pf),
+                                correction="push_sum", prefetch=pf,
+                                diagnostics="on"),
             faults=gossip_fc)
     return ExperimentConfig(
         name=f"chaos-fed-{seed}", seed=100 + seed, data=_DATA,
@@ -107,7 +112,7 @@ def build_cfg(engine: str, seed: int, rounds: int,
         federated=FederatedConfig(algorithm="fedavg", frac=0.5,
                                   rounds=rounds, local_ep=1, local_bs=32,
                                   staleness_max=3, staleness_decay=0.5,
-                                  prefetch=pf),
+                                  prefetch=pf, diagnostics="on"),
         faults=fed_fc)
 
 
@@ -188,8 +193,20 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     summary = check_stream(mem.events)
     assert summary["rounds"] == rounds, summary
     assert summary["kinds"].get("fault", 0) == n_rows, summary
+    # Diagnostics invariants (diagnostics="on"): every round bundle
+    # carries the convergence gauges — their cross-path equality is
+    # pinned by the canonical-stream asserts below — and the
+    # non-deterministic resource channel sampled at least once.
+    from dopt.obs.events import DIAG_GAUGES
+
+    gauge_names = {e["name"] for e in mem.events if e["kind"] == "gauge"}
+    want = set(DIAG_GAUGES) | {"consensus_distance" if engine == "gossip"
+                               else "lane_dispersion"}
+    assert want <= gauge_names, \
+        f"diagnostic gauges missing from the stream: {want - gauge_names}"
+    assert summary["kinds"].get("resource", 0) >= 1, summary
     print(f"[{engine}] telemetry stream ok: {summary['events']} events "
-          f"({summary['kinds']})")
+          f"({summary['kinds']}; diagnostics gauges present)")
 
     # Determinism: the identical config replays the identical storm.
     rerun = build_trainer(engine, seed, rounds)

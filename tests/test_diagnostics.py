@@ -1,0 +1,465 @@
+"""Per-round on-device convergence diagnostics + device-resource
+telemetry (``diagnostics="on"``, dopt.config).
+
+Engine legs are tier-1-lean per the tier-1 budget: mlp, tiny synthetic
+data, 4 rounds, module-scoped fixtures shared across asserts.  The
+cross-path matrix pinned here: per-round vs fused-blocked vs prefetched
+vs killed-and-resumed execution of the same config emit canonically
+IDENTICAL event streams *including* the new diagnostic gauges — the
+PR 8/10 canonical-stream guarantee extended to the diagnostics layer —
+while the non-deterministic ``resource``/``compile`` kinds stay outside
+the comparison (sampling cadence is an execution-path property).
+
+Everything else (rule state machines, event schema, the profiling
+helpers, ledger dedupe, watch rendering) is host-only and synthetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dopt.config import (DataConfig, ExperimentConfig, FederatedConfig,
+                         GossipConfig, ModelConfig, OptimizerConfig,
+                         PopulationConfig)
+from dopt.obs import (MemorySink, PrometheusSink, Telemetry, attach,
+                      canonical, check_stream, make_event, validate_event)
+from dopt.obs.events import DETERMINISTIC_KINDS, DIAG_GAUGES, KINDS
+from dopt.obs.rules import (GradExplosionRule, HbmGrowthRule,
+                            RetraceStormRule, RunContext, default_rules)
+from dopt.utils.profiling import CompileWatcher, device_memory_stats
+
+_DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
+                   synthetic_train_size=128, synthetic_test_size=32)
+_MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
+_OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
+_ROUNDS = 4
+
+# The six per-round convergence gauges each engine emits: the shared
+# five (events.DIAG_GAUGES packed order) + its dispersion meter.
+_GOSSIP_DIAG = set(DIAG_GAUGES) | {"consensus_distance"}
+_FED_DIAG = set(DIAG_GAUGES) | {"lane_dispersion"}
+
+
+def _gossip_cfg(**gossip_kw) -> ExperimentConfig:
+    kw = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+              rounds=_ROUNDS, local_ep=1, local_bs=32, diagnostics="on")
+    kw.update(gossip_kw)
+    return ExperimentConfig(name="diag-gossip", seed=7, data=_DATA,
+                            model=_MODEL, optim=_OPTIM,
+                            gossip=GossipConfig(**kw))
+
+
+def _fed_cfg(**fed_kw) -> ExperimentConfig:
+    kw = dict(algorithm="fedavg", frac=0.5, rounds=_ROUNDS, local_ep=1,
+              local_bs=32, diagnostics="on")
+    kw.update(fed_kw)
+    return ExperimentConfig(name="diag-fed", seed=7, data=_DATA,
+                            model=_MODEL, optim=_OPTIM,
+                            federated=FederatedConfig(**kw))
+
+
+def _trainer(cfg: ExperimentConfig):
+    if cfg.federated is not None:
+        from dopt.engine.federated import FederatedTrainer
+
+        return FederatedTrainer(cfg)
+    from dopt.engine.gossip import GossipTrainer
+
+    return GossipTrainer(cfg)
+
+
+def _run(cfg: ExperimentConfig, *, per_round: bool = False):
+    tr = _trainer(cfg)
+    mem = MemorySink()
+    attach(tr, Telemetry([mem]), fresh=True)
+    if per_round:
+        for _ in range(_ROUNDS):
+            tr.run(rounds=1)
+    else:
+        tr.run(rounds=_ROUNDS)
+    return tr, mem.events
+
+
+@pytest.fixture(scope="module")
+def gossip_on():
+    """Blocked gossip run with diagnostics on — the reference stream."""
+    tr, events = _run(_gossip_cfg())
+    return tr.history, events
+
+
+@pytest.fixture(scope="module")
+def fed_on():
+    tr, events = _run(_fed_cfg())
+    return tr.history, events
+
+
+# ------------------------------------------------- cross-path equality
+def _round_gauges(events) -> dict[int, set]:
+    by_round: dict[int, set] = {}
+    for e in events:
+        if e["kind"] == "gauge":
+            by_round.setdefault(int(e["round"]), set()).add(e["name"])
+    return by_round
+
+
+def test_gossip_diag_stream(gossip_on):
+    _, stream = gossip_on
+    s = check_stream(stream)
+    assert s["rounds"] == _ROUNDS
+    # EVERY round bundle carries all six convergence gauges.
+    for t, names in _round_gauges(stream).items():
+        assert _GOSSIP_DIAG <= names, (t, names)
+    # The resource channel sampled at least once; round fns compiled.
+    assert s["kinds"].get("resource", 0) >= 1
+    assert s["kinds"].get("compile", 0) >= 1
+    # The end-of-run consensus gauge is SUPPRESSED (the diag block
+    # carries a true per-round one): exactly one per round, no extra.
+    cds = [e for e in stream if e["kind"] == "gauge"
+           and e["name"] == "consensus_distance"]
+    assert len(cds) == _ROUNDS
+
+    _, per = _run(_gossip_cfg(), per_round=True)
+    assert canonical(per) == canonical(stream)
+
+
+def test_fed_diag_stream(fed_on):
+    _, stream = fed_on
+    s = check_stream(stream)
+    assert s["rounds"] == _ROUNDS
+    for t, names in _round_gauges(stream).items():
+        assert _FED_DIAG <= names, (t, names)
+    assert s["kinds"].get("resource", 0) >= 1
+    assert s["kinds"].get("compile", 0) >= 1
+
+    _, per = _run(_fed_cfg(), per_round=True)
+    assert canonical(per) == canonical(stream)
+
+
+def test_prefetch_stream_equality(gossip_on, fed_on):
+    _, g_stream = gossip_on
+    _, g_pf = _run(_gossip_cfg(prefetch="on"))
+    assert canonical(g_pf) == canonical(g_stream)
+    _, f_stream = fed_on
+    _, f_pf = _run(_fed_cfg(prefetch="on"))
+    assert canonical(f_pf) == canonical(f_stream)
+
+
+def test_kill_resume_stream_equality(fed_on, tmp_path):
+    """Killed-and-resumed equality WITH gauges included — stronger than
+    the PR 8 round+fault assert, enabled by suppressing the
+    per-``run()``-call end-of-run consensus gauge under diagnostics."""
+    from dopt.obs import JsonlSink
+
+    _, stream = fed_on
+    mpath = tmp_path / "m.jsonl"
+    ck = tmp_path / "ck"
+    kill_at = _ROUNDS // 2
+
+    part = _trainer(_fed_cfg())
+    t1 = Telemetry.to_jsonl(mpath)
+    attach(part, t1)
+    part.run(rounds=kill_at, checkpoint_every=1, checkpoint_path=ck)
+    t1.close()
+
+    res = _trainer(_fed_cfg())
+    res.restore(ck)
+    t2 = Telemetry.to_jsonl(mpath, resume=True)
+    attach(res, t2)
+    res.run(rounds=_ROUNDS - res.round)
+    t2.close()
+
+    merged = JsonlSink.read(mpath)
+    check_stream(merged)
+    assert canonical(merged) == canonical(stream)   # gauges included
+
+
+def test_diag_training_math_unperturbed(gossip_on):
+    """diagnostics="on" observes; it must not change what trains: the
+    History a diagnosed run produces matches the diagnostics-off run's
+    (same schema, values equal up to XLA refusion noise — the extra
+    diag reductions change op fusion, so the last float bits may
+    wiggle; anything past ~1e-5 relative would be a real feedback
+    path)."""
+    h_on, _ = gossip_on
+    off = _trainer(_gossip_cfg(diagnostics="off"))
+    h_off = off.run(rounds=_ROUNDS)
+    assert len(h_off.rows) == len(h_on.rows)
+    for a, b in zip(h_on.rows, h_off.rows):
+        assert a.keys() == b.keys()
+        for k in a:
+            if isinstance(a[k], float):
+                assert a[k] == pytest.approx(b[k], rel=1e-5, abs=1e-7), k
+            else:
+                assert a[k] == b[k], k
+
+
+# -------------------------------------------------------- config gates
+def test_bad_diagnostics_value_rejected():
+    with pytest.raises(ValueError, match="diagnostics"):
+        _trainer(_gossip_cfg(diagnostics="sometimes"))
+    with pytest.raises(ValueError, match="diagnostics"):
+        _trainer(_fed_cfg(diagnostics="sometimes"))
+
+
+def test_population_mode_rejected():
+    cfg = dataclasses.replace(
+        _fed_cfg(), population=PopulationConfig(clients=32, cohort=16))
+    with pytest.raises(ValueError, match="population"):
+        _trainer(cfg)
+    gcfg = dataclasses.replace(
+        _gossip_cfg(), population=PopulationConfig(clients=32, cohort=16))
+    with pytest.raises(ValueError, match="population"):
+        _trainer(gcfg)
+
+
+# ------------------------------------------------------- event schema
+def test_resource_compile_kinds_registered():
+    assert "resource" in KINDS and "compile" in KINDS
+    # Sampling cadence is an execution-path property: both kinds stay
+    # OUTSIDE the canonical-stream comparison.
+    assert "resource" not in DETERMINISTIC_KINDS
+    assert "compile" not in DETERMINISTIC_KINDS
+
+
+def test_resource_compile_events_validate():
+    validate_event(make_event("resource", round=3, engine="gossip",
+                              live_bytes=1 << 20, peak_bytes=2 << 20,
+                              source="host_rss"))
+    validate_event(make_event("resource", round=0, peak_bytes=0))
+    validate_event(make_event("compile", round=0, fn="round_fn",
+                              count=1, total=2, seconds=0.5))
+
+
+@pytest.mark.parametrize("bad", [
+    {"v": 1, "kind": "resource", "ts": 0.0, "round": 0},  # no peak_bytes
+    {"v": 1, "kind": "resource", "ts": 0.0, "round": 0,
+     "peak_bytes": -1},                                   # negative
+    {"v": 1, "kind": "resource", "ts": 0.0, "round": 0,
+     "peak_bytes": float("inf")},                         # non-finite
+    {"v": 1, "kind": "compile", "ts": 0.0, "round": 0,
+     "count": 1, "seconds": 0.1},                         # missing fn
+    {"v": 1, "kind": "compile", "ts": 0.0, "round": 0, "fn": "f",
+     "count": 0, "seconds": 0.1},                         # count < 1
+    {"v": 1, "kind": "compile", "ts": 0.0, "round": 0, "fn": "f",
+     "count": 1, "seconds": float("nan")},                # non-finite s
+])
+def test_malformed_resource_compile_rejected(bad):
+    with pytest.raises(ValueError):
+        validate_event(bad)
+
+
+# ------------------------------------------------------------- rules
+def _gauge(t, name, value):
+    return make_event("gauge", round=t, name=name, value=value)
+
+
+def test_grad_explosion_rule_edge_and_per_gauge_windows():
+    r = GradExplosionRule(window=8, factor=10.0, min_delta=1.0,
+                          min_history=3)
+    ctx = RunContext()
+    fired = []
+    for t in range(4):          # below min_history then steady
+        fired += r.update(_gauge(t, "grad_norm", 1.0), ctx)
+    assert not fired
+    fired = r.update(_gauge(4, "grad_norm", 50.0), ctx)   # 10x1+1 < 50
+    assert len(fired) == 1 and "grad_norm" in fired[0]["message"]
+    # Edge-triggered: the episode fires once...
+    assert not r.update(_gauge(5, "grad_norm", 60.0), ctx)
+    # ...re-arms when the condition clears (median has crept up), and
+    # update_norm keeps its OWN window: no cross-gauge contamination.
+    for t in range(6, 10):
+        r.update(_gauge(t, "grad_norm", 1.0), ctx)
+    for t in range(10, 13):
+        assert not r.update(_gauge(t, "update_norm", 1.0), ctx)
+    assert r.update(_gauge(13, "update_norm", 100.0), ctx)
+    # Other gauges pass through untouched.
+    assert not r.update(_gauge(14, "lane_loss_mean", 1e9), ctx)
+
+
+def test_retrace_storm_rule():
+    r = RetraceStormRule(window=8, max_rounds=3)
+    ctx = RunContext()
+
+    def compile_ev(t):
+        return make_event("compile", round=t, fn="round_fn", count=1,
+                          total=t + 1, seconds=0.1)
+
+    # Warmup compiles at 2 distinct rounds: healthy, silent.
+    assert not r.update(compile_ev(0), ctx)
+    assert not r.update(compile_ev(0), ctx)
+    assert not r.update(compile_ev(1), ctx)
+    assert not r.update(compile_ev(2), ctx)     # 3 distinct = at limit
+    fired = r.update(compile_ev(3), ctx)        # 4th distinct round
+    assert len(fired) == 1 and fired[0]["value"] == 4.0
+    # Old rounds age out of the window; the rule re-arms.
+    assert not r.update(compile_ev(20), ctx)
+
+
+def test_hbm_growth_rule():
+    r = HbmGrowthRule(patience=4, tol=0.5, min_bytes=64 << 20)
+    ctx = RunContext()
+
+    def res(t, live):
+        return make_event("resource", round=t, live_bytes=live,
+                          peak_bytes=live)
+
+    g = 1 << 30
+    # Plateau: silent.
+    for t in range(6):
+        assert not r.update(res(t, g), ctx)
+    # Strictly-rising but under both margins: silent.
+    for t in range(6, 11):
+        assert not r.update(res(t, g + (t << 10)), ctx)
+    # The leak shape: 5 consecutive strictly-rising samples, +50% rel
+    # AND +64MiB abs.
+    fired = []
+    for i, t in enumerate(range(11, 16)):
+        fired += r.update(res(t, g + i * (300 << 20)), ctx)
+    assert len(fired) == 1
+    # Falls back to peak_bytes when live_bytes is absent; non-numeric
+    # samples are ignored, not crashed on.
+    assert not r.update({"v": 1, "kind": "resource", "ts": 0.0,
+                         "round": 16, "peak_bytes": g}, ctx)
+    assert not r.update({"v": 1, "kind": "resource", "ts": 0.0,
+                         "round": 17}, ctx)
+
+
+def test_new_rules_in_default_set():
+    names = {r.name for r in default_rules()}
+    assert {"grad_explosion", "retrace_storm", "hbm_growth"} <= names
+
+
+# -------------------------------------------------- profiling helpers
+def test_device_memory_stats_finite():
+    mem = device_memory_stats()
+    assert mem is not None
+    assert mem["source"] in ("device", "host_rss")
+    assert isinstance(mem["peak_bytes"], int) and mem["peak_bytes"] > 0
+    assert isinstance(mem["live_bytes"], int) and mem["live_bytes"] > 0
+
+
+def test_compile_watcher():
+    class _Fn:
+        def __init__(self):
+            self.n = 0
+
+        def _cache_size(self):
+            return self.n
+
+    fn = _Fn()
+    w = CompileWatcher()
+    assert w.observe("f", fn) is None          # empty cache: no signal
+    fn.n = 1
+    assert w.observe("f", fn) == {"count": 1, "total": 1}   # warmup
+    assert w.observe("f", fn) is None          # stable: no retrace
+    fn.n = 3
+    assert w.observe("f", fn) == {"count": 2, "total": 3}   # retraced
+    # Wrappers without a cache probe degrade to silence, not a crash.
+    assert w.observe("g", object()) is None
+
+
+# --------------------------------------------------- ledger dedupe
+def test_bench_ledger_dedupes_on_run_id(tmp_path):
+    from dopt.obs.regress import append_entry, read_ledger
+
+    path = tmp_path / "bench_history.jsonl"
+    append_entry(path, {"metric": "m", "value": 1.0}, run_id="r1", sha="s")
+    append_entry(path, {"metric": "m", "value": 2.0}, run_id="r2", sha="s")
+    # Re-run at r1 REPLACES the stale entry instead of stacking a
+    # duplicate that would skew the trailing trimmed median.
+    append_entry(path, {"metric": "m", "value": 9.0}, run_id="r1", sha="s")
+    entries = read_ledger(path)
+    assert [e["run_id"] for e in entries] == ["r2", "r1"]
+    assert entries[-1]["bench"]["value"] == 9.0
+    assert len(path.read_text().splitlines()) == 2
+
+
+def test_bench_ledger_append_survives_torn_line(tmp_path):
+    """The plain-append path is not atomic, so a crash can tear the
+    final line; the next append must not raise, must not glue its entry
+    onto the garbage, and must REPAIR the ledger (drop the torn line)
+    so the strict read_ledger / regressor CLI keeps working."""
+    from dopt.obs.regress import append_entry, read_ledger
+
+    path = tmp_path / "bench_history.jsonl"
+    append_entry(path, {"metric": "m", "value": 1.0}, run_id="r1", sha="s")
+    with open(path, "a") as f:
+        f.write('{"bench": {"metric": "m", "va')  # torn mid-write
+    append_entry(path, {"metric": "m", "value": 2.0}, run_id="r2", sha="s")
+    entries = read_ledger(path)  # strict read works again
+    assert [e["run_id"] for e in entries] == ["r1", "r2"]
+    assert len(path.read_text().splitlines()) == 2
+
+
+def test_consensus_stall_reads_lane_dispersion():
+    """The federated engine's diagnostics dispersion meter is named
+    lane_dispersion; the stall rule must consume it — otherwise
+    diagnostics='on' (which suppresses the end-of-run
+    consensus_distance gauge) would disable stall monitoring there."""
+    from dopt.obs.rules import ConsensusStallRule
+
+    ctx = RunContext()
+    r = ConsensusStallRule(patience=3, tol=0.25)
+    fired = []
+    for t, v in enumerate([1.0, 1.5, 2.0, 3.0]):
+        fired += r.update(make_event("gauge", round=t,
+                                     name="lane_dispersion", value=v), ctx)
+    assert len(fired) == 1 and fired[0]["round"] == 3
+
+
+# ------------------------------------------------------------- watch
+def test_watch_renders_all_gauges_and_memory(tmp_path):
+    from dopt.obs.monitor import HealthMonitor
+    from dopt.obs.watch import WatchState
+
+    events = [
+        make_event("run", engine="gossip", name="x", round=0, workers=8),
+        make_event("round", round=0, engine="gossip",
+                   metrics={"avg_train_loss": 0.5}),
+        _gauge(0, "update_norm", 1.25),
+        _gauge(0, "consensus_distance", 0.5),
+        _gauge(0, "some_future_gauge", 3.0),
+        make_event("resource", round=0, engine="gossip",
+                   live_bytes=1 << 30, peak_bytes=2 << 30,
+                   source="host_rss"),
+        make_event("compile", round=0, fn="round_fn", count=1,
+                   seconds=0.2),
+    ]
+    mpath = tmp_path / "m.jsonl"
+    mpath.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+    state = WatchState(HealthMonitor())
+    state.poll(mpath)
+    out = state.render()
+    # No whitelist: every gauge in the stream renders, unknown ones
+    # included — new diagnostic gauges surface without a code edit.
+    for name in ("update_norm", "consensus_distance",
+                 "some_future_gauge"):
+        assert name in out
+    assert "peak=2.00GiB" in out and "live=1.00GiB" in out
+    assert "compiles=1" in out
+
+    filt = WatchState(HealthMonitor(), gauge_filter={"update_norm"})
+    filt.poll(mpath)
+    out = filt.render()
+    assert "update_norm" in out and "some_future_gauge" not in out
+
+
+def test_prometheus_resource_and_compile_families():
+    sink = PrometheusSink()
+    sink.emit(make_event("resource", round=0, engine="gossip",
+                         live_bytes=100, peak_bytes=200,
+                         source="host_rss"))
+    sink.emit(make_event("compile", round=0, fn="round_fn", count=2,
+                         seconds=0.1))
+    sink.emit(make_event("compile", round=1, fn="round_fn", count=1,
+                         seconds=0.1))
+    text = sink.render()
+    assert 'dopt_hbm_live_bytes{engine_kind="gossip"} 100.0' in text
+    assert 'dopt_hbm_peak_bytes{engine_kind="gossip"} 200.0' in text
+    assert 'dopt_compiles_total{fn="round_fn"} 3' in text
