@@ -1,0 +1,42 @@
+"""Static gates for the invariants the test suite cannot cheaply pin.
+
+Every PR in this repo ends by re-proving the same three properties by
+hand: default-off knobs compile the exact pre-change programs, library
+code never reads wall clocks or unseeded RNG on deterministic paths,
+and the construction-time eligibility rejections still match the
+ARCHITECTURE.md composition matrix.  ``dopt.analysis`` turns each
+ritual into a commit-time gate:
+
+``python -m dopt.analysis.lint dopt/``
+    Trace-safety & determinism linter — a stdlib-``ast`` pass flagging
+    wall-clock reads, global-state RNG, retrace/trace hazards inside
+    jit-reachable functions, and non-deterministic telemetry emission
+    outside ``dopt.obs``.  Audited legitimate uses carry a
+    ``# dopt: allow-<rule> -- <justification>`` pragma.
+
+``python -m dopt.analysis.eligibility``
+    Eligibility-matrix extractor — statically harvests every
+    construction-time ``raise ValueError`` across the config/engine
+    constructors into ``results/eligibility.json`` and cross-checks
+    the composition rejections against the ARCHITECTURE.md
+    eligibility-matrix table, so feature×feature drift fails CI
+    instead of rotting in the docs.
+
+``python -m dopt.analysis.fingerprint``
+    Program-fingerprint registry — lowers the canonical default-off
+    round programs (both engines, tiny CPU shapes, the
+    baseline1/baseline3 matrix), hashes the canonicalized IR, and
+    diffs against the committed ``results/program_fingerprints.json``;
+    ``--bless --reason "..."`` regenerates with a recorded
+    justification.
+
+All three CLIs share the ``dopt.obs.check`` conventions: exit 0 clean,
+1 findings, 2 usage error; ``--json`` emits machine output for CI
+annotation (``dopt.analysis.common``).
+"""
+
+from dopt.analysis.common import (EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE,
+                                  Finding, parse_pragmas)
+
+__all__ = ["EXIT_CLEAN", "EXIT_FINDINGS", "EXIT_USAGE", "Finding",
+           "parse_pragmas"]

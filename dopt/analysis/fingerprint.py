@@ -1,0 +1,297 @@
+"""Program-fingerprint gate: ``python -m dopt.analysis.fingerprint``.
+
+Every default-off knob in this repo ships with the same promise:
+"off compiles the exact pre-change programs".  Until now that promise
+was re-proven per PR by hand (lower the round function, diff the HLO).
+This gate turns it into a commit-time check: the canonical DEFAULT
+round programs — both engines, tiny CPU shapes, the
+baseline1/baseline3 config matrix — are lowered via the engines'
+``lower_round`` hook (which consumes the same ``_round_dispatch``
+builder the real ``run`` loop dispatches, so the pinned program IS the
+shipped program), their StableHLO text canonicalized and hashed, and
+the hashes diffed against the committed
+``results/program_fingerprints.json``.
+
+* A PR that does not touch the default path leaves every hash intact —
+  the gate is green with zero effort.
+* A PR that changes what the default path compiles (a new op inside
+  ``round_fn``, a knob that leaks into the off program, a changed
+  constant) flips a hash and FAILS until the change is blessed:
+  ``--bless --reason "<why the default program legitimately changed>"``
+  regenerates the registry with the justification recorded — the
+  off-path byte-identity ritual becomes one reviewed line in the diff.
+
+Fingerprints are environment-sensitive (StableHLO text varies across
+jax versions and backends), so the registry records the environment it
+was blessed under; on mismatch the gate SKIPS (exit 0, reported) unless
+``--strict`` — CI pins ``JAX_PLATFORMS=cpu`` and one jax version, so
+the gate is always live there.
+
+Exit codes: 0 clean/skipped, 1 drift, 2 usage error; ``--json`` prints
+the machine-readable report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+from dopt.analysis.common import (EXIT_CLEAN, EXIT_USAGE, Finding,
+                                  emit_report)
+
+DEFAULT_REGISTRY = "results/program_fingerprints.json"
+
+# Tiny-shape overrides: the fingerprint pins program STRUCTURE (ops,
+# routing, constants baked by the config), not workload scale — small
+# synthetic data keeps the gate seconds-cheap on one CPU.
+_TINY_TRAIN, _TINY_TEST = 256, 64
+
+
+def _tiny(cfg):
+    return cfg.replace(data=dataclasses.replace(
+        cfg.data, dataset="synthetic", data_dir=None,
+        synthetic_train_size=_TINY_TRAIN, synthetic_test_size=_TINY_TEST))
+
+
+def canonical_matrix() -> dict[str, Callable[[], Any]]:
+    """The default-off config matrix the gate pins, name → config
+    builder.  baseline1 exercises the gossip dense consensus round,
+    baseline3 the federated engine on BOTH execution paths (frac=1 →
+    full-width ``round_fn``; its preset frac=0.5 on one CPU device →
+    auto-compact ``compact_fn``)."""
+    from dopt.presets import (baseline_1_ring_mnist_mlp,
+                              baseline_3_fedavg_noniid)
+
+    def b1():
+        return _tiny(baseline_1_ring_mnist_mlp())
+
+    def b3_full():
+        cfg = _tiny(baseline_3_fedavg_noniid())
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data,
+                                                   num_users=4))
+        return cfg.replace(federated=dataclasses.replace(
+            cfg.federated, frac=1.0))
+
+    def b3_compact():
+        cfg = _tiny(baseline_3_fedavg_noniid())
+        return cfg.replace(data=dataclasses.replace(cfg.data,
+                                                    num_users=4))
+
+    return {"baseline1-tiny": b1,
+            "baseline3-tiny-full": b3_full,
+            "baseline3-tiny-compact": b3_compact}
+
+
+_LOC_RE = re.compile(r'\s*loc\([^()]*\)|^#loc.*$', re.MULTILINE)
+
+
+def canonicalize(text: str) -> str:
+    """Strip source-location debris so the hash tracks the PROGRAM:
+    plain line shifts in engine files must not flip fingerprints."""
+    text = _LOC_RE.sub("", text)
+    return "\n".join(line.rstrip() for line in text.splitlines()) + "\n"
+
+
+def current_env() -> dict[str, Any]:
+    """The fingerprint environment key.  Device COUNT is part of it:
+    the same config lowers a different (sharded) module on an 8-device
+    virtual mesh than on one chip, so registries only compare within
+    an identical (jax, backend, devices) triple."""
+    import jax
+
+    return {"jax": jax.__version__, "backend": jax.default_backend(),
+            "devices": jax.device_count()}
+
+
+def _build_trainer(cfg):
+    if cfg.gossip is not None:
+        from dopt.engine.gossip import GossipTrainer
+
+        return "gossip", GossipTrainer(cfg)
+    from dopt.engine.federated import FederatedTrainer
+
+    return "federated", FederatedTrainer(cfg)
+
+
+def compute_fingerprints(
+        configs: Mapping[str, Callable[[], Any]] | None = None,
+) -> dict[str, dict[str, Any]]:
+    """Lower each config's round-0 program on a fresh trainer and hash
+    the canonicalized module text."""
+    configs = canonical_matrix() if configs is None else configs
+    out: dict[str, dict[str, Any]] = {}
+    for name in sorted(configs):
+        engine, trainer = _build_trainer(configs[name]())
+        fn_name, lowered = trainer.lower_round(0)
+        text = canonicalize(lowered.as_text())
+        out[name] = {
+            "engine": engine,
+            "fn": fn_name,
+            "sha256": hashlib.sha256(text.encode()).hexdigest(),
+            "chars": len(text),
+        }
+    return out
+
+
+def diff(current: Mapping[str, dict], committed: Mapping[str, dict],
+         registry_path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in sorted(set(current) - set(committed)):
+        findings.append(Finding(
+            "fingerprint-new", registry_path, 0,
+            f"{name}: canonical program not in the registry — bless it "
+            f"(--bless --reason ...)"))
+    for name in sorted(set(committed) - set(current)):
+        findings.append(Finding(
+            "fingerprint-removed", registry_path, 0,
+            f"{name}: registered program no longer in the canonical "
+            f"matrix — bless the removal"))
+    for name in sorted(set(current) & set(committed)):
+        cur, old = current[name], committed[name]
+        if cur["sha256"] != old["sha256"]:
+            findings.append(Finding(
+                "fingerprint-mismatch", registry_path, 0,
+                f"{name} ({cur['engine']}/{cur['fn']}): the DEFAULT "
+                f"round program changed — {old['sha256'][:12]} → "
+                f"{cur['sha256'][:12]} ({old['chars']} → "
+                f"{cur['chars']} chars).  If intended, re-bless with "
+                f"--bless --reason '<why>'"))
+        elif (cur["fn"], cur["engine"]) != (old["fn"], old["engine"]):
+            findings.append(Finding(
+                "fingerprint-mismatch", registry_path, 0,
+                f"{name}: dispatch routing changed "
+                f"({old['engine']}/{old['fn']} → "
+                f"{cur['engine']}/{cur['fn']})"))
+    return findings
+
+
+def load_registry(path: str | Path) -> dict[str, Any] | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def write_registry(path: str | Path, fingerprints: Mapping[str, dict],
+                   env: Mapping[str, str], reason: str) -> None:
+    doc = {"v": 1, "env": dict(env), "bless": {"reason": reason},
+           "fingerprints": {k: dict(v)
+                            for k, v in sorted(fingerprints.items())}}
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dopt.analysis.fingerprint",
+        description="Off-path program-fingerprint gate for the "
+                    "canonical default round programs.")
+    ap.add_argument("names", nargs="*", metavar="NAME",
+                    help="subset of canonical programs to check "
+                         "(default: all)")
+    ap.add_argument("--registry", default=DEFAULT_REGISTRY,
+                    help=f"committed registry (default: "
+                         f"{DEFAULT_REGISTRY})")
+    ap.add_argument("--bless", action="store_true",
+                    help="regenerate the registry from the current "
+                         "tree (requires --reason)")
+    ap.add_argument("--reason", default="",
+                    help="justification recorded with --bless — why "
+                         "the default programs legitimately changed")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (instead of skip) on environment "
+                         "mismatch with the blessed registry")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    if args.bless and not args.reason.strip():
+        print("--bless requires --reason '<why the default programs "
+              "changed>'", file=sys.stderr)
+        return EXIT_USAGE
+    matrix = canonical_matrix()
+    if args.names:
+        unknown = set(args.names) - set(matrix)
+        if unknown:
+            print(f"unknown program(s): {', '.join(sorted(unknown))}; "
+                  f"canonical: {', '.join(sorted(matrix))}",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        matrix = {k: matrix[k] for k in args.names}
+
+    env = current_env()
+    if args.bless:
+        full = set(matrix) == set(canonical_matrix())
+        if not full:
+            # Partial bless: merge over the committed registry — only
+            # sound when the kept entries were computed under THIS
+            # environment, since the registry carries one env for all.
+            old = load_registry(args.registry) or {"fingerprints": {}}
+            if old.get("fingerprints") and old.get("env") != env:
+                print(
+                    f"partial bless refused: {args.registry} is "
+                    f"blessed under {old.get('env')}, this is {env} — "
+                    "merging would stamp stale hashes with the wrong "
+                    "env.  Bless the full matrix instead (no NAME "
+                    "args).", file=sys.stderr)
+                return EXIT_USAGE
+            merged = dict(old.get("fingerprints", {}))
+            merged.update(compute_fingerprints(matrix))
+            fps = merged
+        else:
+            fps = compute_fingerprints(matrix)
+        # The recorded reason describes the MOST RECENT bless.
+        write_registry(args.registry, fps, env, args.reason.strip())
+        print(f"blessed {len(fps)} fingerprint(s) into "
+              f"{args.registry} (reason: {args.reason.strip()})")
+        return EXIT_CLEAN
+
+    committed = load_registry(args.registry)
+    if committed is None:
+        return emit_report(
+            [Finding("registry-missing", args.registry, 0,
+                     "no committed fingerprint registry — run "
+                     "`python -m dopt.analysis.fingerprint --bless "
+                     "--reason 'initial registry'`")],
+            as_json=args.json, tool="dopt.analysis.fingerprint",
+            checked=0, unit="program")
+    if committed.get("env") != env:
+        skip = {"status": "skipped", "reason": "environment mismatch",
+                "blessed_env": committed.get("env"), "current_env": env}
+        if args.strict:
+            return emit_report(
+                [Finding("environment-mismatch", args.registry, 0,
+                         f"registry blessed under "
+                         f"{committed.get('env')}, running under "
+                         f"{env}")],
+                as_json=args.json, tool="dopt.analysis.fingerprint",
+                checked=0, unit="program", extra=skip)
+        if args.json:
+            return emit_report([], as_json=True,
+                               tool="dopt.analysis.fingerprint",
+                               checked=0, unit="program", extra=skip)
+        print("dopt.analysis.fingerprint: SKIPPED — environment "
+              f"mismatch (registry blessed under {committed.get('env')}, "
+              f"running under {env}); 0 programs compared.  Use "
+              "--strict to fail instead.")
+        return EXIT_CLEAN
+    fps = compute_fingerprints(matrix)
+    committed_fps = committed.get("fingerprints", {})
+    if args.names:
+        committed_fps = {k: v for k, v in committed_fps.items()
+                         if k in args.names}
+    findings = diff(fps, committed_fps, args.registry)
+    return emit_report(findings, as_json=args.json,
+                       tool="dopt.analysis.fingerprint",
+                       checked=len(fps), unit="program",
+                       extra={"fingerprints": fps})
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,497 @@
+"""Trace-safety & determinism linter: ``python -m dopt.analysis.lint dopt/``.
+
+A stdlib-``ast`` pass over library code enforcing the determinism
+contract the engines are built on (stateless per-round draws, one
+compiled program per shape, telemetry that cannot perturb replay):
+
+``wallclock``
+    Wall-clock reads (``time.time``/``monotonic``/``perf_counter``,
+    ``datetime.now``) in library code.  Deterministic paths must not
+    consult the clock; span timing and telemetry timestamps are the
+    audited exceptions (pragma).
+
+``unseeded-rng``
+    Global-state RNG: the legacy ``np.random.*`` module-level API,
+    stdlib ``random.*`` module functions, seedless
+    ``np.random.default_rng()`` / ``random.Random()``.  Library draws
+    must come from explicit seeded generators
+    (``dopt.utils.prng.host_rng``) so fault traces, cohorts and batch
+    plans replay from the config alone.
+
+``trace-hazard``
+    Retrace/trace hazards inside functions reachable from
+    ``jax.jit`` / ``lax.scan`` / ``lax.cond`` / ``vmap`` /
+    ``shard_map`` call sites: ``.item()`` / ``.tolist()`` host syncs,
+    ``int()/float()/bool()`` coercion of traced arguments (each one a
+    retrace-per-value or concretization error), and data-dependent
+    output shapes (``nonzero`` / ``flatnonzero`` / ``unique`` — the
+    survivor-counts-as-shapes class PR 4/PR 7 eliminated).
+    Reachability is a per-module approximation: functions named at a
+    jit/scan/cond/vmap call site or decorated with a jit wrapper,
+    plus everything they transitively call through local names.
+
+``nondet-event``
+    Emission of non-``DETERMINISTIC_KINDS`` telemetry outside
+    ``dopt/obs`` — the canonical-stream guarantee says engine code
+    emits only ``round``/``fault``/``gauge`` (plus the ``run``
+    header); ``alert``/``checkpoint``/``resource``/``compile`` sites
+    in engine code are deliberate exceptions and carry pragmas.
+
+Suppression: ``# dopt: allow-<rule> -- <justification>`` on any line
+of the flagged statement (multi-line calls included) or the line
+directly above it.  The justification is mandatory; a
+bare pragma or an unknown rule name is itself a finding (rule
+``pragma``, not suppressible).  Exit codes: 0 clean, 1 findings, 2
+usage error; ``--json`` prints the machine-readable report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+from pathlib import Path
+
+from dopt.analysis.common import (EXIT_USAGE, Finding, emit_report,
+                                  iter_py_files, parse_pragmas, pragma_for)
+from dopt.obs.events import DETERMINISTIC_KINDS
+
+RULES = ("wallclock", "unseeded-rng", "trace-hazard", "nondet-event")
+
+# time.* attributes that read a clock.
+_CLOCK_ATTRS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                "perf_counter", "perf_counter_ns", "localtime", "gmtime"}
+# datetime.* / datetime.datetime.* constructors that read a clock.
+_DATETIME_NOW = {"now", "utcnow", "today"}
+# Legacy numpy global-state RNG API (np.random.<fn> mutates or draws
+# from the hidden global RandomState).
+_NP_GLOBAL_RNG = {
+    "seed", "rand", "randn", "randint", "random", "random_sample",
+    "ranf", "sample", "choice", "permutation", "shuffle", "normal",
+    "uniform", "standard_normal", "binomial", "poisson", "beta",
+    "gamma", "exponential", "bytes", "get_state", "set_state",
+}
+# stdlib random module-level functions (the hidden global Random()).
+_PY_GLOBAL_RNG = {
+    "seed", "random", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "uniform", "gauss", "normalvariate",
+    "getrandbits", "betavariate", "expovariate", "triangular",
+}
+# Call sites whose function-valued arguments enter a traced context.
+_JIT_ENTRY_ATTRS = {"jit", "scan", "cond", "while_loop", "fori_loop",
+                    "switch", "vmap", "pmap", "checkpoint", "remat",
+                    "shard_map", "grad", "value_and_grad"}
+# Data-dependent output shapes: nonzero(mask) makes the survivor count
+# a SHAPE — a retrace (or concretization error) per distinct count.
+_SHAPE_POLY = {"nonzero", "flatnonzero", "unique", "argwhere"}
+
+# Kinds engine code may emit directly; everything else is the obs
+# subsystem's job (or a pragma'd, documented exception).
+_ALLOWED_KINDS = set(DETERMINISTIC_KINDS) | {"run"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+    ``@jax.checkpoint`` — anything that puts the decorated body in a
+    traced context."""
+    if isinstance(dec, ast.Call):
+        head = _dotted(dec.func)
+        if head is not None and head.split(".")[-1] == "partial":
+            return any(_is_jit_decorator(a) for a in dec.args)
+        dec = dec.func
+    name = _dotted(dec)
+    return name is not None and name.split(".")[-1] in _JIT_ENTRY_ATTRS
+
+
+def _static_params(call: ast.AST, params_in_order: list[str]) -> set[str]:
+    """Parameter names declared static in a jit wrapper call
+    (``static_argnames=(...)`` / ``static_argnums=(...)``): static args
+    are Python values, so coercing them is NOT a trace hazard."""
+    out: set[str] = set()
+    if not isinstance(call, ast.Call):
+        return out
+    for kw in call.keywords:
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        if kw.arg == "static_argnames":
+            names = [val] if isinstance(val, str) else list(val)
+            out.update(str(n) for n in names)
+        elif kw.arg == "static_argnums":
+            nums = [val] if isinstance(val, int) else list(val)
+            out.update(params_in_order[n] for n in nums
+                       if 0 <= n < len(params_in_order))
+    return out
+
+
+class _FuncInfo:
+    """One lexical scope (module / class / function / lambda)."""
+
+    def __init__(self, node: ast.AST | None, qualname: str,
+                 parent: "_FuncInfo | None") -> None:
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+        self.children: dict[str, "_FuncInfo"] = {}
+        self.calls: set[str] = set()          # locally-called names
+        self.params: set[str] = set()
+        self.params_in_order: list[str] = []
+        self.static: set[str] = set()         # static_argnames/argnums
+        self.is_function = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        if self.is_function:
+            a = node.args
+            self.params_in_order = [p.arg for p in (a.posonlyargs
+                                                    + a.args)]
+            self.params = set(self.params_in_order) | {
+                p.arg for p in a.kwonlyargs}
+            if a.vararg:
+                self.params.add(a.vararg.arg)
+            if a.kwarg:
+                self.params.add(a.kwarg.arg)
+
+
+class _Analyzer(ast.NodeVisitor):
+    """One pass per module: builds the function scope tree, records
+    jit-entry roots and local call edges, and collects rule hits
+    (trace hazards held back until reachability is known)."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        # dopt/obs IS the telemetry subsystem — the sanctioned producer
+        # of the non-deterministic kinds.
+        self.in_obs = "dopt/obs" in Path(path).as_posix()
+        self.imports: dict[str, str] = {}
+        self.root = _FuncInfo(None, "<module>", None)
+        self.scope = self.root
+        self.jit_roots: set[_FuncInfo] = set()
+        self.findings: list[Finding] = []
+        # (rule, line, end_line, message, scope, names) — names, when
+        # non-None, must intersect the scope's NON-STATIC params for
+        # the finding to fire (checked at resolve time, once
+        # static_argnames from later jit call sites are known).
+        self.deferred: list[
+            tuple[str, int, int | None, str, _FuncInfo,
+                  set[str] | None]] = []
+        self.pragmas = parse_pragmas(source)
+
+    # -- scope handling -------------------------------------------------
+    def _enter(self, node: ast.AST, name: str) -> _FuncInfo:
+        qn = (name if self.scope is self.root
+              else f"{self.scope.qualname}.{name}")
+        info = _FuncInfo(node, qn, self.scope)
+        self.scope.children[name] = info
+        self.scope = info
+        return info
+
+    def _exit(self) -> None:
+        assert self.scope.parent is not None
+        self.scope = self.scope.parent
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._handle_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._handle_func(node, node.name)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._enter(node, node.name)
+        self.generic_visit(node)
+        self._exit()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        info = self._enter(node, f"<lambda:{node.lineno}>")
+        if getattr(node, "_dopt_jit_root", False):
+            self.jit_roots.add(info)
+        self.generic_visit(node)
+        self._exit()
+
+    def _handle_func(self, node, name: str) -> None:
+        jit_decs = [d for d in node.decorator_list
+                    if _is_jit_decorator(d)]
+        info = self._enter(node, name)
+        if jit_decs:
+            self.jit_roots.add(info)
+            for d in jit_decs:
+                info.static |= _static_params(d, info.params_in_order)
+        self.generic_visit(node)
+        self._exit()
+
+    def _resolve(self, name: str,
+                 scope: "_FuncInfo") -> "_FuncInfo | None":
+        s: _FuncInfo | None = scope
+        while s is not None:
+            if name in s.children:
+                return s.children[name]
+            s = s.parent
+        return None
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.asname:
+                self.imports[a.asname] = a.name
+            else:
+                # `import numpy.random` binds the TOP-LEVEL name
+                # `numpy`; references then spell the full dotted path
+                # themselves, so the head maps to itself (mapping it to
+                # the submodule would corrupt canonicalization).
+                head = a.name.split(".")[0]
+                self.imports[head] = head
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is not None:
+            for a in node.names:
+                self.imports[a.asname or a.name] = \
+                    f"{node.module}.{a.name}"
+
+    def _canonical(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        base = self.imports.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    # -- the rules ------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name):
+            self.scope.calls.add(node.func.id)
+        dotted = _dotted(node.func)
+        canon = self._canonical(dotted) if dotted else None
+        if canon is not None:
+            self._check_wallclock(node, canon)
+            self._check_unseeded_rng(node, canon)
+        self._check_nondet_event(node, dotted)
+        self._check_jit_entry_call(node, dotted)
+        self._check_trace_hazard_call(node, canon)
+        self.generic_visit(node)
+
+    def _finding(self, rule: str, line: int, message: str,
+                 end: int | None = None) -> None:
+        # Any matching pragma suppresses the underlying finding; a
+        # BARE one still fails via the unconditional justification
+        # sweep in lint_source, whether or not it suppressed anything.
+        if pragma_for(self.pragmas, rule, line, end) is None:
+            self.findings.append(Finding(rule, self.path, line, message))
+
+    def _check_wallclock(self, node: ast.Call, canon: str) -> None:
+        mod, _, attr = canon.rpartition(".")
+        hit = ((mod == "time" and attr in _CLOCK_ATTRS)
+               or (mod in ("datetime", "datetime.datetime",
+                           "datetime.date") and attr in _DATETIME_NOW))
+        if hit:
+            self._finding(
+                "wallclock", node.lineno,
+                f"wall-clock read `{canon}()` in library code — "
+                "deterministic paths must not consult the clock",
+                end=node.end_lineno)
+
+    def _check_unseeded_rng(self, node: ast.Call, canon: str) -> None:
+        mod, _, attr = canon.rpartition(".")
+        if mod == "numpy.random" and attr in _NP_GLOBAL_RNG:
+            self._finding(
+                "unseeded-rng", node.lineno,
+                f"global-state RNG `np.random.{attr}()` — draw from an "
+                "explicit seeded generator (dopt.utils.prng.host_rng)",
+                end=node.end_lineno)
+        elif canon == "numpy.random.default_rng" and not (
+                node.args or node.keywords):
+            self._finding(
+                "unseeded-rng", node.lineno,
+                "seedless `np.random.default_rng()` draws from OS "
+                "entropy — pass an explicit seed", end=node.end_lineno)
+        elif mod == "random" and attr in _PY_GLOBAL_RNG:
+            self._finding(
+                "unseeded-rng", node.lineno,
+                f"stdlib global RNG `random.{attr}()` — use an explicit "
+                "seeded generator", end=node.end_lineno)
+        elif canon == "random.Random" and not (node.args or node.keywords):
+            self._finding(
+                "unseeded-rng", node.lineno,
+                "seedless `random.Random()` — pass an explicit seed",
+                end=node.end_lineno)
+
+    def _check_nondet_event(self, node: ast.Call,
+                            dotted: str | None) -> None:
+        is_emit = (isinstance(node.func, ast.Attribute)
+                   and node.func.attr == "emit")
+        is_make = (dotted is not None
+                   and dotted.split(".")[-1] == "make_event")
+        if self.in_obs or not (is_emit or is_make):
+            return
+        kind = (node.args[0] if node.args
+                else next((kw.value for kw in node.keywords
+                           if kw.arg == "kind"), None))
+        if (isinstance(kind, ast.Constant) and isinstance(kind.value, str)
+                and kind.value not in _ALLOWED_KINDS):
+            self._finding(
+                "nondet-event", node.lineno,
+                f"emission of non-deterministic kind {kind.value!r} "
+                f"outside dopt/obs — only {sorted(_ALLOWED_KINDS)} "
+                "keep the canonical-stream guarantee",
+                end=node.end_lineno)
+
+    def _check_jit_entry_call(self, node: ast.Call,
+                              dotted: str | None) -> None:
+        if dotted is None or dotted.split(".")[-1] not in _JIT_ENTRY_ATTRS:
+            return
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                info = self._resolve(arg.id, self.scope)
+                if info is not None:
+                    self.jit_roots.add(info)
+                    info.static |= _static_params(
+                        node, info.params_in_order)
+            elif isinstance(arg, ast.Lambda):
+                # Visited (after this call returns) as a child scope;
+                # the marker survives into visit_Lambda.
+                arg._dopt_jit_root = True  # type: ignore[attr-defined]
+
+    def _enclosing_function(self) -> _FuncInfo | None:
+        s: _FuncInfo | None = self.scope
+        while s is not None and not s.is_function:
+            s = s.parent
+        return s
+
+    def _check_trace_hazard_call(self, node: ast.Call,
+                                 canon: str | None) -> None:
+        scope = self._enclosing_function()
+        if scope is None:
+            return
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("item", "tolist") and not node.args:
+                self.deferred.append((
+                    "trace-hazard", node.lineno, node.end_lineno,
+                    f"`.{node.func.attr}()` forces a host sync / "
+                    "concretization inside a jit-reachable function",
+                    scope, None))
+            elif node.func.attr in _SHAPE_POLY:
+                self.deferred.append((
+                    "trace-hazard", node.lineno, node.end_lineno,
+                    f"data-dependent output shape `{node.func.attr}` "
+                    "inside a jit-reachable function — survivor counts "
+                    "must stay data, not shapes", scope, None))
+        if canon in ("int", "float", "bool") and len(node.args) == 1:
+            arg = node.args[0]
+            names = {n.id for n in ast.walk(arg)
+                     if isinstance(n, ast.Name)}
+            if not isinstance(arg, ast.Constant) and names & scope.params:
+                self.deferred.append((
+                    "trace-hazard", node.lineno, node.end_lineno,
+                    f"`{canon}()` coercion of a traced argument inside "
+                    "a jit-reachable function concretizes (or retraces "
+                    "per value)", scope, names))
+
+    # -- resolution -----------------------------------------------------
+    def resolve(self) -> list[Finding]:
+        reachable: set[_FuncInfo] = set()
+        frontier = list(self.jit_roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn in reachable:
+                continue
+            reachable.add(fn)
+            for name in fn.calls:
+                callee = self._resolve(name, fn)
+                if callee is not None and callee not in reachable:
+                    frontier.append(callee)
+        for rule, line, end, message, scope, names in self.deferred:
+            if names is not None and not (
+                    names & (scope.params - scope.static)):
+                continue
+            s: _FuncInfo | None = scope
+            while s is not None:
+                if s in reachable:
+                    self._finding(rule, line, message, end=end)
+                    break
+                s = s.parent
+        return self.findings
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: tuple[str, ...] = RULES) -> list[Finding]:
+    """Lint one module's source; returns surviving findings."""
+    tree = ast.parse(source, filename=path)
+    an = _Analyzer(path, source)
+    an.visit(tree)
+    findings = an.resolve()
+    known = set(RULES) | {"pragma"}
+    for line, pragmas in an.pragmas.items():
+        for p in pragmas:
+            if p.rule not in known:
+                findings.append(Finding(
+                    "pragma", path, line,
+                    f"unknown pragma rule `allow-{p.rule}` (rules: "
+                    f"{', '.join(RULES)})"))
+            elif not p.justification:
+                # Unconditional: a bare pragma is a finding whether or
+                # not it currently suppresses anything — stale and
+                # pre-placed pragmas must not erode the audit trail.
+                findings.append(Finding(
+                    "pragma", path, line,
+                    f"allow-{p.rule} pragma without a justification "
+                    f"(write `# dopt: allow-{p.rule} -- <why>`)"))
+    return [f for f in findings if f.rule == "pragma" or f.rule in rules]
+
+
+def lint_paths(paths: list[str],
+               rules: tuple[str, ...] = RULES) -> tuple[list[Finding], int]:
+    findings: list[Finding] = []
+    checked = 0
+    for p in iter_py_files(paths):
+        checked += 1
+        try:
+            src = p.read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("io", str(p), 0, str(e)))
+            continue
+        try:
+            findings.extend(lint_source(src, str(p), rules))
+        except SyntaxError as e:
+            findings.append(Finding("io", str(p), e.lineno or 0,
+                                    f"syntax error: {e.msg}"))
+    return findings, checked
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dopt.analysis.lint",
+        description="Trace-safety & determinism linter for dopt "
+                    "library code.")
+    ap.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files/directories to lint (default: dopt)")
+    ap.add_argument("--rules", default=",".join(RULES),
+                    help="comma-separated rule subset "
+                         f"(default: {','.join(RULES)})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    rules = tuple(r for r in args.rules.split(",") if r)
+    unknown = set(rules) - set(RULES)
+    if unknown:
+        print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+              f"valid: {', '.join(RULES)}", file=sys.stderr)
+        return EXIT_USAGE
+    paths = args.paths or ["dopt"]
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return EXIT_USAGE
+    findings, checked = lint_paths(paths, rules)
+    return emit_report(findings, as_json=args.json,
+                       tool="dopt.analysis.lint", checked=checked)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1795,7 +1795,7 @@ class FederatedTrainer:
         crosses a checkpoint boundary, and client quarantine was
         rejected at construction (its eligibility feedback only exists
         after the fetch)."""
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         stager = PrefetchStager() if self._prefetch else None
         try:
             self._population_loop(rounds, checkpoint_every,
@@ -1803,7 +1803,7 @@ class FederatedTrainer:
         finally:
             if stager is not None:
                 stager.discard()
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
 
@@ -1892,7 +1892,7 @@ class FederatedTrainer:
                 checkpoint_path=checkpoint_path)
         compact = self._use_compact(frac)
         fixed_c = compact and self.faults.active
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
         stager = PrefetchStager() if self._prefetch else None
@@ -1903,7 +1903,7 @@ class FederatedTrainer:
         finally:
             if stager is not None:
                 stager.discard()
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
 
@@ -2077,7 +2077,7 @@ class FederatedTrainer:
         execution."""
         w = self.num_workers
         m = max(int(frac * w), 1)
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
         stager = PrefetchStager() if self._prefetch else None
@@ -2088,7 +2088,7 @@ class FederatedTrainer:
         finally:
             if stager is not None:
                 stager.discard()
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
 
@@ -2269,67 +2269,14 @@ class FederatedTrainer:
             return self._run_blocked(frac, rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
-        compact = self._use_compact(frac)
-        fixed_c = compact and self.faults.active
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                (sel, limits, cmask, frows, cap,
-                 admit) = self._round_participation(t, frac)
-                if fixed_c:
-                    # Fixed-width compact fault lanes: survivors first,
-                    # padding ids after, validity as data — one
-                    # compiled program for every survivor count (no
-                    # per-count retrace), identical semantics to the
-                    # old variable-width path up to float summation
-                    # order.
-                    sel_lanes, valid_np = self._fixed_width_sel(sel, frac)
-                else:
-                    sel_lanes, valid_np = sel, None
-                use_c = compact and sel_lanes.size > 0
-                # Compact path: plan only the m sampled workers' rows —
-                # host cost O(m), and the RNG is keyed by true worker id
-                # so the plans are bit-identical to the full plan's rows.
-                plan = make_batch_plan(
-                    self._plan_matrix_for_round(t), batch_size=f.local_bs,
-                    local_ep=f.local_ep,
-                    seed=cfg.seed, round_idx=t, impl=cfg.data.plan_impl,
-                    workers=sel_lanes if use_c else None,
-                )
-                if use_c:
-                    idx = jnp.asarray(plan.idx)
-                    bweight = jnp.asarray(plan.weight)
-                    lim_dev = jnp.asarray(limits[sel_lanes])
-                else:
-                    mask = np.zeros(self.num_workers, np.float32)
-                    mask[sel] = 1.0
-                    idx = jax.device_put(plan.idx, self._sharding)
-                    bweight = jax.device_put(plan.weight, self._sharding)
-                    lim_dev = jnp.asarray(limits)
-            duals_in = self.duals if self.duals is not None else {}
-            c_in = self.c_global if self.c_global is not None else {}
-            step_fn = self._compact_fn if use_c else self._round_fn
-            gate = jnp.asarray(sel_lanes) if use_c else jnp.asarray(mask)
-            step_kw = ({"cmask": jnp.asarray(
-                cmask[sel_lanes] if use_c else cmask)}
-                if self._has_corrupt else {})
-            if fixed_c and use_c:
-                step_kw["valid"] = jnp.asarray(valid_np)
-            if self._has_stale:
-                step_kw.update(
-                    load_mask=jnp.asarray(np.clip(mask + cap, 0.0, 1.0)),
-                    stale_p=self._stale_p,
-                    admit_w=jnp.asarray(admit),
-                    capture=jnp.asarray(cap))
-            out = self.timers.measure(
-                "round_step", step_fn,
-                self.theta, self.params, self.momentum, duals_in, c_in,
-                gate, lim_dev, idx, bweight,
-                self._train_x, self._train_y, *self._eval,
-                self._train_eval_idx, self._train_eval_w, *self._val,
-                **step_kw,
-            )
+                (fn_name, step_fn, args, step_kw, sel, sel_lanes,
+                 use_c, frows) = self._round_dispatch(t, frac)
+            out = self.timers.measure("round_step", step_fn, *args,
+                                      **step_kw)
             (self.theta, self.params, self.momentum, new_duals,
              new_c) = out[:5]
             if self._has_stale:
@@ -2366,14 +2313,97 @@ class FederatedTrainer:
                       else {k_: v[sel] for k_, v in em.items()})
                 self._append_client_rows(t, em, sel)
             self._round_telemetry(t, frows, diag)
-            self._device_telemetry(
-                t, "compact_fn" if use_c else "round_fn", step_fn)
+            self._device_telemetry(t, fn_name, step_fn)
             self.round += 1
             if checkpoint_every and self.round % checkpoint_every == 0:
                 self.save(checkpoint_path)
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
+
+    def _round_dispatch(self, t: int, frac: float):
+        """Round ``t``'s device dispatch, fully built: ``(fn_name,
+        step_fn, args, kwargs, sel, sel_lanes, use_c, frows)``.  The
+        ONE builder both the per-round ``run`` loop and ``lower_round``
+        consume — which is what makes the program-fingerprint gate
+        (``dopt.analysis.fingerprint``) pin the program the real loop
+        actually dispatches, with no mirror to drift.  Advances the
+        same stateful host draws (sampling RNG, ledger rows) the run
+        loop would."""
+        cfg, f = self.cfg, self.cfg.federated
+        compact = self._use_compact(frac)
+        fixed_c = compact and self.faults.active
+        (sel, limits, cmask, frows, cap,
+         admit) = self._round_participation(t, frac)
+        if fixed_c:
+            # Fixed-width compact fault lanes: survivors first, padding
+            # ids after, validity as data — one compiled program for
+            # every survivor count (no per-count retrace), identical
+            # semantics to the old variable-width path up to float
+            # summation order.
+            sel_lanes, valid_np = self._fixed_width_sel(sel, frac)
+        else:
+            sel_lanes, valid_np = sel, None
+        use_c = compact and sel_lanes.size > 0
+        # Compact path: plan only the m sampled workers' rows — host
+        # cost O(m), and the RNG is keyed by true worker id so the
+        # plans are bit-identical to the full plan's rows.
+        plan = make_batch_plan(
+            self._plan_matrix_for_round(t), batch_size=f.local_bs,
+            local_ep=f.local_ep, seed=cfg.seed, round_idx=t,
+            impl=cfg.data.plan_impl,
+            workers=sel_lanes if use_c else None)
+        if use_c:
+            idx = jnp.asarray(plan.idx)
+            bweight = jnp.asarray(plan.weight)
+            lim_dev = jnp.asarray(limits[sel_lanes])
+        else:
+            mask = np.zeros(self.num_workers, np.float32)
+            mask[sel] = 1.0
+            idx = jax.device_put(plan.idx, self._sharding)
+            bweight = jax.device_put(plan.weight, self._sharding)
+            lim_dev = jnp.asarray(limits)
+        duals_in = self.duals if self.duals is not None else {}
+        c_in = self.c_global if self.c_global is not None else {}
+        step_fn = self._compact_fn if use_c else self._round_fn
+        gate = jnp.asarray(sel_lanes) if use_c else jnp.asarray(mask)
+        step_kw = ({"cmask": jnp.asarray(
+            cmask[sel_lanes] if use_c else cmask)}
+            if self._has_corrupt else {})
+        if fixed_c and use_c:
+            step_kw["valid"] = jnp.asarray(valid_np)
+        if self._has_stale:
+            step_kw.update(
+                load_mask=jnp.asarray(np.clip(mask + cap, 0.0, 1.0)),
+                stale_p=self._stale_p,
+                admit_w=jnp.asarray(admit),
+                capture=jnp.asarray(cap))
+        args = (self.theta, self.params, self.momentum, duals_in, c_in,
+                gate, lim_dev, idx, bweight,
+                self._train_x, self._train_y, *self._eval,
+                self._train_eval_idx, self._train_eval_w, *self._val)
+        return ("compact_fn" if use_c else "round_fn", step_fn, args,
+                step_kw, sel, sel_lanes, use_c, frows)
+
+    def lower_round(self, t: int | None = None,
+                    frac: float | None = None):
+        """Lower (without executing) round ``t``'s device step exactly
+        as the per-round ``run`` loop would dispatch it — same
+        ``_round_dispatch`` builder, so the two cannot diverge — and
+        return ``(fn_name, jax.stages.Lowered)``.  The program-
+        fingerprint hook; call it on a FRESHLY CONSTRUCTED trainer only
+        (the participation draw advances the run loop's sampling
+        RNG)."""
+        if self._registry is not None:
+            raise ValueError(
+                "lower_round covers the worker==lane per-round paths; "
+                "population mode dispatches the wave scan instead")
+        f = self.cfg.federated
+        frac = f.frac if frac is None else frac
+        t = self.round if t is None else t
+        fn_name, step_fn, args, step_kw, *_ = self._round_dispatch(
+            t, frac)
+        return fn_name, step_fn.lower(*args, **step_kw)
 
     def _unpack_host_metrics(self, vec: np.ndarray, lanes: int):
         """Inverse of the round step's ``pack_host_metrics``: one fetched
@@ -2523,7 +2553,7 @@ class FederatedTrainer:
             cd = self._consensus_value()
             if cd is not None:
                 ev["consensus_distance"] = cd
-            self.telemetry.emit("checkpoint", **ev)
+            self.telemetry.emit("checkpoint", **ev)  # dopt: allow-nondet-event -- checkpoint cadence is an execution-path property, documented non-deterministic
 
     def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint

@@ -1344,7 +1344,7 @@ class GossipTrainer:
         ``prefetch='off'`` runs the exact pre-change host loop."""
         link = self._link_mode
         fused_quar = self._fused_quar
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         next_ckpt = (self.round // checkpoint_every + 1) * checkpoint_every \
             if checkpoint_every else None
         stager = PrefetchStager() if self._prefetch else None
@@ -1354,7 +1354,7 @@ class GossipTrainer:
         finally:
             if stager is not None:
                 stager.discard()
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
 
@@ -1821,43 +1821,19 @@ class GossipTrainer:
             return self._run_blocked(rounds, block,
                                      checkpoint_every=checkpoint_every,
                                      checkpoint_path=checkpoint_path)
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         for _ in range(rounds):
             t = self.round
             with self.timers.phase("host_batch_plan"):
-                w_t, alive, limits, cmask, frows, quar = \
-                    self._round_inputs(t)
-                plan = self._round_plan(t)
-                idx = jax.device_put(plan.idx, self._sharding)
-                bweight = jax.device_put(plan.weight, self._sharding)
-            do_eval = (t % self.eval_every) == 0
-            step_kw = ({"cmask": jnp.asarray(cmask)}
-                       if self._has_corrupt else {})
-            if self._fused_quar:
-                # The quarantine fold + matrix repair happen ON DEVICE
-                # (effective_inputs), identically to the blocked path.
-                step_kw["quar"] = jnp.asarray(quar)
+                (fn_name, step_fn, args, step_kw, alive, quar, frows,
+                 do_eval) = self._round_dispatch(t)
+            out = self.timers.measure("round_step", step_fn, *args,
+                                      **step_kw)
             if self._link_mode:
                 (self.params, self.momentum, self._mass, self._link_buf,
-                 self._link_buf_mass, packed) = self.timers.measure(
-                    "round_step", self._link_round_fn,
-                    self.params, self.momentum, self._mass,
-                    self._link_buf, self._link_buf_mass,
-                    jnp.asarray(w_t), alive, limits,
-                    jnp.asarray(t, jnp.int32), idx, bweight,
-                    self._train_x, self._train_y, *self._eval, *self._val,
-                    do_eval, **step_kw,
-                )
+                 self._link_buf_mass, packed) = out
             else:
-                (self.params, self.momentum, self.x_hat,
-                 packed) = self.timers.measure(
-                    "round_step", self._round_fn,
-                    self.params, self.momentum, self.x_hat, w_t, alive,
-                    limits,
-                    jnp.asarray(t, jnp.int32), idx, bweight,
-                    self._train_x, self._train_y, *self._eval, *self._val,
-                    do_eval, **step_kw,
-                )
+                self.params, self.momentum, self.x_hat, packed = out
             tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
                 np.asarray(packed))  # ONE device→host fetch per round
             if self._robust_active:
@@ -1877,16 +1853,61 @@ class GossipTrainer:
             if self._holdout:
                 self._append_client_rows(t, em)
             self._round_telemetry(t, frows, diag)
-            self._device_telemetry(
-                t, "link_round_fn" if self._link_mode else "round_fn",
-                self._link_round_fn if self._link_mode else self._round_fn)
+            self._device_telemetry(t, fn_name, step_fn)
             self.round += 1
             if (checkpoint_every and
                     self.round % checkpoint_every == 0):
                 self.save(checkpoint_path)
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         self._run_summary_telemetry()
         return self.history
+
+    def _round_dispatch(self, t: int):
+        """Round ``t``'s device dispatch, fully built: ``(fn_name,
+        step_fn, args, kwargs, alive, quar, frows, do_eval)``.  The ONE
+        builder both the per-round ``run`` loop and ``lower_round``
+        consume — which is what makes the program-fingerprint gate
+        (``dopt.analysis.fingerprint``) pin the program the real loop
+        actually dispatches, with no mirror to drift.  Advances the
+        same stateful host draws (matching RNG, ledger rows) the run
+        loop would."""
+        w_t, alive, limits, cmask, frows, quar = self._round_inputs(t)
+        plan = self._round_plan(t)
+        idx = jax.device_put(plan.idx, self._sharding)
+        bweight = jax.device_put(plan.weight, self._sharding)
+        do_eval = (t % self.eval_every) == 0
+        step_kw = ({"cmask": jnp.asarray(cmask)}
+                   if self._has_corrupt else {})
+        if self._fused_quar:
+            # The quarantine fold + matrix repair happen ON DEVICE
+            # (effective_inputs), identically to the blocked path.
+            step_kw["quar"] = jnp.asarray(quar)
+        if self._link_mode:
+            args = (self.params, self.momentum, self._mass,
+                    self._link_buf, self._link_buf_mass,
+                    jnp.asarray(w_t), alive, limits,
+                    jnp.asarray(t, jnp.int32), idx, bweight,
+                    self._train_x, self._train_y, *self._eval,
+                    *self._val, do_eval)
+            return ("link_round_fn", self._link_round_fn, args, step_kw,
+                    alive, quar, frows, do_eval)
+        args = (self.params, self.momentum, self.x_hat, w_t, alive,
+                limits, jnp.asarray(t, jnp.int32), idx, bweight,
+                self._train_x, self._train_y, *self._eval, *self._val,
+                do_eval)
+        return ("round_fn", self._round_fn, args, step_kw, alive, quar,
+                frows, do_eval)
+
+    def lower_round(self, t: int | None = None):
+        """Lower (without executing) round ``t``'s device step exactly
+        as the per-round ``run`` loop would dispatch it — same
+        ``_round_dispatch`` builder, so the two cannot diverge — and
+        return ``(fn_name, jax.stages.Lowered)``.  The program-
+        fingerprint hook; call it on a FRESHLY CONSTRUCTED trainer only
+        (building the inputs consumes the run loop's stateful draws)."""
+        t = self.round if t is None else t
+        fn_name, step_fn, args, step_kw, *_ = self._round_dispatch(t)
+        return fn_name, step_fn.lower(*args, **step_kw)
 
     # ------------------------------------------------------------------
     def save(self, path) -> None:
@@ -1909,7 +1930,7 @@ class GossipTrainer:
             cd = self._consensus_value()
             if cd is not None:
                 ev["consensus_distance"] = cd
-            self.telemetry.emit("checkpoint", **ev)
+            self.telemetry.emit("checkpoint", **ev)  # dopt: allow-nondet-event -- checkpoint cadence is an execution-path property, documented non-deterministic
 
     def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint

@@ -18,7 +18,6 @@ TPU mapping SURVEY §2.3 calls for.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Callable
 
 import jax

@@ -275,7 +275,7 @@ class OracleGossipTrainer(_TorchTrainerBase):
                              "here)")
         eps = g.eps if (g.algorithm == "fedlcon"
                         and not g.faithful_bugs) else 1
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         for _ in range(rounds):
             t = self.round
             if self.mixing is not None:
@@ -307,7 +307,7 @@ class OracleGossipTrainer(_TorchTrainerBase):
                 avg_test_loss=float(np.mean(losses_m)),
             )
             self.round += 1
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         return self.history
 
     def evaluate(self) -> dict[str, np.ndarray]:
@@ -357,7 +357,7 @@ class OracleFederatedTrainer(_TorchTrainerBase):
         frac = f.frac if frac is None else frac
         rounds = f.rounds if rounds is None else rounds
         algo = f.algorithm
-        t0 = time.time()
+        t0 = time.time()  # dopt: allow-wallclock -- total_time wall meter, reporting only
         for _ in range(rounds):
             t = self.round
             m = max(int(frac * self.num_workers), 1)
@@ -420,7 +420,7 @@ class OracleFederatedTrainer(_TorchTrainerBase):
                 local_loss=float(np.mean(local_losses)),
             )
             self.round += 1
-        self.total_time = time.time() - t0
+        self.total_time = time.time() - t0  # dopt: allow-wallclock -- total_time wall meter, reporting only
         return self.history
 
     def theta_as_flax(self):

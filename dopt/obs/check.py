@@ -3,17 +3,20 @@
 Validates every event against the versioned schema (dopt.obs.events)
 and enforces the continuity invariant — within each ``run`` segment the
 round sequence is gapless and duplicate-free — then prints a one-line
-summary per file.  Exit code 1 on the first violation, so CI can gate
-on the artifact it just produced.  ``--summary`` additionally prints a
-per-file inventory (per-kind event counts, round span per segment,
-gauge key inventory, alert rules fired) — the eyeball view of a
-10k-round stream the pass/fail line can't give.  Stdlib-only (no jax
-import).
+summary per file.  ``--summary`` additionally prints a per-file
+inventory (per-kind event counts, round span per segment, gauge key
+inventory, alert rules fired) — the eyeball view of a 10k-round stream
+the pass/fail line can't give.  Stdlib-only (no jax import).
+
+Exit codes follow the shared ``dopt.analysis`` convention: 0 every
+stream clean, 1 any violation, 2 usage error (argparse); ``--json``
+prints one machine-readable report for CI annotation.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import Any
 
@@ -106,8 +109,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="print a per-file inventory (per-kind counts, "
                          "round span per segment, gauge keys, alert "
                          "rules) after validating")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (the "
+                         "dopt.analysis CLI convention)")
     args = ap.parse_args(argv)
     rc = 0
+    report: list[dict[str, Any]] = []
     for path in args.paths:
         try:
             events = JsonlSink.read(path)
@@ -115,14 +122,29 @@ def main(argv: list[str] | None = None) -> int:
                 raise ValueError(f"{path}: empty telemetry stream")
             s = check_stream(events)
         except (OSError, ValueError) as e:
-            print(f"{path}: FAIL {e}", file=sys.stderr)
+            if args.json:
+                report.append({"path": path, "ok": False,
+                               "error": str(e)})
+            else:
+                print(f"{path}: FAIL {e}", file=sys.stderr)
             rc = 1
+            continue
+        if args.json:
+            entry: dict[str, Any] = {"path": path, "ok": True, **s}
+            if args.summary:
+                entry["summary"] = summarize(events)
+            report.append(entry)
             continue
         kinds = " ".join(f"{k}={v}" for k, v in sorted(s["kinds"].items()))
         print(f"{path}: ok — {s['events']} events, {s['rounds']} rounds, "
               f"{s['segments']} segment(s) [{kinds}]")
         if args.summary:
             print_summary(path, summarize(events))
+    if args.json:
+        json.dump({"tool": "dopt.obs.check", "checked": len(args.paths),
+                   "files": report, "clean": rc == 0},
+                  sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
     return rc
 
 

@@ -116,7 +116,7 @@ def make_event(kind: str, **fields: Any) -> dict[str, Any]:
     """Build one schema-stamped event; top-level ``None`` fields are
     dropped (absent beats null for optional fields)."""
     ev: dict[str, Any] = {"v": SCHEMA_VERSION, "kind": kind,
-                          "ts": round(time.time(), 6)}
+                          "ts": round(time.time(), 6)}  # dopt: allow-wallclock -- the schema ts stamp; canonical() drops it before any replay comparison
     ev.update({k: v for k, v in fields.items() if v is not None})
     return ev
 

@@ -15,8 +15,8 @@ import numpy as np
 
 def setup_seed(seed: int) -> None:
     """Seed every global RNG the oracle backend touches."""
-    random.seed(seed)
-    np.random.seed(seed)
+    random.seed(seed)      # dopt: allow-unseeded-rng -- host-side seeding of the torch oracle's globals (this IS the seeding site)
+    np.random.seed(seed)   # dopt: allow-unseeded-rng -- host-side seeding of the torch oracle's globals (this IS the seeding site)
     try:
         import torch
 

@@ -49,23 +49,23 @@ class PhaseTimers:
         use ``measure`` to include device time)."""
         span = (self.tracer.span(name) if self.tracer is not None
                 else contextlib.nullcontext())
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # dopt: allow-wallclock -- phase span timing, not training math
         try:
             with span:
                 yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            self.totals[name] += time.perf_counter() - t0  # dopt: allow-wallclock -- phase span timing, not training math
             self.counts[name] += 1
 
     def measure(self, name: str, fn, *args, **kwargs):
         """Run fn, block on its result, attribute the time to ``name``."""
         span = (self.tracer.span(name) if self.tracer is not None
                 else contextlib.nullcontext())
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # dopt: allow-wallclock -- measure span timing, not training math
         with span:
             out = fn(*args, **kwargs)
             jax.block_until_ready(out)
-        self.totals[name] += time.perf_counter() - t0
+        self.totals[name] += time.perf_counter() - t0  # dopt: allow-wallclock -- measure span timing, not training math
         self.counts[name] += 1
         return out
 
@@ -270,7 +270,7 @@ def device_stats_of(fn, *, trace_prefix: str = "dopt-devtime-",
         if warning is not None:
             stats["warning"] = warning
             if telemetry is not None:
-                telemetry.emit("warning", message=warning,
+                telemetry.emit("warning", message=warning,  # dopt: allow-nondet-event -- degraded-profiler warning, outside DETERMINISTIC_KINDS by design
                                source="device_stats_of")
         return stats
     finally:
@@ -342,12 +342,12 @@ def emit_device_resource(trainer, t: int, fn_name: str, fn) -> None:
     trainer._last_step_total = step_total
     comp = trainer._compile_watch.observe(fn_name, fn)
     if comp is not None:
-        tele.emit("compile", round=int(t), fn=fn_name,
+        tele.emit("compile", round=int(t), fn=fn_name,  # dopt: allow-nondet-event -- retrace channel is execution-path state, documented non-deterministic
                   count=comp["count"], total=comp["total"],
                   seconds=round(seconds, 6))
     stats = device_memory_stats()
     if stats is not None:
-        tele.emit("resource", round=int(t), engine=trainer.engine_kind,
+        tele.emit("resource", round=int(t), engine=trainer.engine_kind,  # dopt: allow-nondet-event -- HBM occupancy sampling cadence is execution-path state, documented non-deterministic
                   **stats)
 
 

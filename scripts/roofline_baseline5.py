@@ -86,7 +86,6 @@ def main() -> int:
     args = ap.parse_args()
 
     import jax
-    import dataclasses
 
     from dopt.presets import get_preset
     from dopt.utils.profiling import device_peak_flops, train_flops_per_sample
