@@ -57,9 +57,12 @@ from typing import Any, Iterable
 from dopt.analysis.common import (EXIT_USAGE, Finding, emit_report,
                                   iter_py_files)
 
-# The constructor surface the matrix lives in.
+# The constructor surface the matrix lives in.  dopt/serve/daemon.py
+# joins for the serve-mode construction rejections (engine choice,
+# on_term); the rest of dopt/serve is command-schema validation, not
+# configuration eligibility.
 DEFAULT_ROOTS = ("dopt/config.py", "dopt/engine", "dopt/population.py",
-                 "dopt/robust.py", "dopt/parallel")
+                 "dopt/robust.py", "dopt/parallel", "dopt/serve/daemon.py")
 DEFAULT_ARTIFACT = "results/eligibility.json"
 DEFAULT_DOC = "docs/ARCHITECTURE.md"
 

@@ -78,9 +78,17 @@ class FederatedTrainer:
 
     engine_kind = "federated"
 
-    def __init__(self, cfg: ExperimentConfig, *, eval_train: bool = True):
+    def __init__(self, cfg: ExperimentConfig, *, eval_train: bool = True,
+                 membership=None):
         if cfg.federated is None:
             raise ValueError("cfg.federated must be set for FederatedTrainer")
+        if membership is not None and cfg.population is not None:
+            raise ValueError(
+                "the serve membership overlay does not compose with the "
+                "client population registry (cohort sampling already "
+                "models client join/leave; a lane-level overlay would "
+                "silently fight the registry's shard assignment) — drop "
+                "one of the two")
         f = cfg.federated
         if f.algorithm not in ("fedavg", "fedprox", "fedadmm", "scaffold"):
             raise ValueError(f"unknown federated algorithm {f.algorithm!r}")
@@ -105,6 +113,10 @@ class FederatedTrainer:
         # post-fetch boundary, so the compiled device programs are
         # independent of it either way.
         self.telemetry = None
+        # Serve-mode hooks (dopt.serve): see GossipTrainer — same
+        # contract, same controller protocol.
+        self._suppress_run_summary = False
+        self.checkpoint_writer = True
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -119,7 +131,8 @@ class FederatedTrainer:
         # state; it rejoins by reloading theta when next sampled.  The
         # device programs only ever see masks/gates/limits as data, so
         # the fault-free compiled program is exactly the pre-fault one.
-        self.faults = FaultPlan(w, cfg.faults, seed=cfg.seed)
+        self.faults = FaultPlan(w, cfg.faults, seed=cfg.seed,
+                                membership=membership)
         has_faults = self.faults.active
         may_straggle = (self.faults.may_straggle
                         and cfg.faults.straggler_policy == "partial")
@@ -2321,6 +2334,27 @@ class FederatedTrainer:
         self._run_summary_telemetry()
         return self.history
 
+    def run_served(self, controller) -> str:
+        """Resident serve-mode entry (``dopt.serve``): train one round
+        at a time until the round-boundary ``controller`` says
+        otherwise.  Same contract as ``GossipTrainer.run_served``:
+        ``controller.boundary(trainer)`` runs at every round boundary
+        and returns ``"run"`` | ``"drain"`` | ``"restart"`` |
+        ``"rebuild"``; the end-of-run summary gauge is emitted exactly
+        once, at the drain boundary."""
+        self._suppress_run_summary = True
+        try:
+            while True:
+                verdict = controller.boundary(self)
+                if verdict != "run":
+                    if verdict == "drain":
+                        self._suppress_run_summary = False
+                        self._run_summary_telemetry()
+                    return verdict
+                self.run(rounds=1)
+        finally:
+            self._suppress_run_summary = False
+
     def _round_dispatch(self, t: int, frac: float):
         """Round ``t``'s device dispatch, fully built: ``(fn_name,
         step_fn, args, kwargs, sel, sel_lanes, use_c, frows)``.  The
@@ -2506,6 +2540,11 @@ class FederatedTrainer:
         params are not client state — or a diverged fleet)."""
         if self.round == 0 or self._registry is not None:
             return None
+        if jax.process_count() > 1:
+            # Multi-process fleet: this reduction is a collective over
+            # cross-process-sharded params but only the telemetry-
+            # attached leader calls it — see GossipTrainer.
+            return None
         import math
 
         from dopt.obs import consensus_distance
@@ -2524,7 +2563,7 @@ class FederatedTrainer:
         an extra one mid-stream, breaking the gauges-included canonical
         equality diagnostics guarantees."""
         tele = self.telemetry
-        if tele is None or self._diag:
+        if tele is None or self._diag or self._suppress_run_summary:
             return
         cd = self._consensus_value()
         if cd is not None:
@@ -2589,7 +2628,8 @@ class FederatedTrainer:
             # sampler itself is stateless, so this plus the round index
             # is everything a bit-exact mid-population resume needs.
             meta["population_registry"] = self._registry.state_dict()
-        save_checkpoint(path, arrays=arrays, meta=meta)
+        save_checkpoint(path, arrays=arrays, meta=meta,
+                        write=self.checkpoint_writer)
 
     def restore(self, path) -> None:
         from dopt.utils.checkpoint import load_checkpoint

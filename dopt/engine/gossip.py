@@ -113,9 +113,17 @@ class GossipTrainer:
 
     engine_kind = "gossip"
 
-    def __init__(self, cfg: ExperimentConfig, *, eval_every: int = 1):
+    def __init__(self, cfg: ExperimentConfig, *, eval_every: int = 1,
+                 membership=None):
         if cfg.gossip is None:
             raise ValueError("cfg.gossip must be set for GossipTrainer")
+        if membership is not None and cfg.population is not None:
+            raise ValueError(
+                "the serve membership overlay does not compose with the "
+                "client population registry (cohort sampling already "
+                "models client join/leave; a lane-level overlay would "
+                "silently fight the registry's shard assignment) — drop "
+                "one of the two")
         g = cfg.gossip
         if g.algorithm not in ("dsgd", "nocons", "centralized", "fedlcon",
                                "gossip", "choco"):
@@ -152,6 +160,13 @@ class GossipTrainer:
         # python-gated host code after the post-fetch boundary, so the
         # compiled device programs are independent of it either way.
         self.telemetry = None
+        # Serve-mode hooks (dopt.serve): ``run_served`` drives the loop
+        # one round per controller tick and defers the end-of-run
+        # summary gauge to the drain boundary; followers of a
+        # multi-process serve fleet participate in checkpoint
+        # collectives but leave the write to the leader.
+        self._suppress_run_summary = False
+        self.checkpoint_writer = True
 
         w = cfg.data.num_users
         self.num_workers = w
@@ -254,7 +269,7 @@ class GossipTrainer:
         # state via where_mask (elastic rejoin).  ``GossipConfig.dropout``
         # is the back-compat alias for crash-only faults.
         self.faults = FaultPlan(w, cfg.faults, seed=cfg.seed,
-                                dropout=g.dropout)
+                                dropout=g.dropout, membership=membership)
         has_faults = self.faults.active
         may_straggle = self.faults.may_straggle
 
@@ -1566,6 +1581,14 @@ class GossipTrainer:
         (round 0, or a diverged fleet)."""
         if self.round == 0:
             return None
+        if jax.process_count() > 1:
+            # Multi-process fleet: the reduction below is a COLLECTIVE
+            # over cross-process-sharded params, but only the telemetry
+            #-attached leader reaches this call site — computing it
+            # would strand the leader in a collective the followers
+            # never join.  Fleets report consensus via diagnostics="on"
+            # (inside the compiled round, all processes) instead.
+            return None
         import math
 
         from dopt.obs import consensus_distance
@@ -1583,7 +1606,7 @@ class GossipTrainer:
         resumed run would emit an extra one mid-stream, breaking the
         gauges-included canonical equality diagnostics guarantees."""
         tele = self.telemetry
-        if tele is None or self._diag:
+        if tele is None or self._diag or self._suppress_run_summary:
             return
         cd = self._consensus_value()
         if cd is not None:
@@ -1862,6 +1885,37 @@ class GossipTrainer:
         self._run_summary_telemetry()
         return self.history
 
+    def run_served(self, controller) -> str:
+        """Resident serve-mode entry (``dopt.serve``): train one round
+        at a time until the round-boundary ``controller`` says
+        otherwise — the "run until told otherwise" loop a daemon owns
+        instead of a ``--rounds N`` script.
+
+        ``controller.boundary(trainer)`` is called BEFORE each round
+        with the trainer at a consistent round boundary; it may apply
+        control-plane effects (membership directives, checkpoints,
+        ledgered ``control`` rows) and returns ``"run"`` to train one
+        more round or a stop verdict: ``"drain"`` (graceful stop —
+        the one end-of-run summary gauge is emitted here, matching a
+        scripted ``run()``'s cadence), ``"restart"`` (checkpoint and
+        hand control back for a process re-exec; NO summary gauge —
+        the resumed daemon's drain emits it, so an interrupted and an
+        uninterrupted serve emit identical streams), or ``"rebuild"``
+        (the daemon must reconstruct the trainer from an updated
+        config, restore, and call ``run_served`` again)."""
+        self._suppress_run_summary = True
+        try:
+            while True:
+                verdict = controller.boundary(self)
+                if verdict != "run":
+                    if verdict == "drain":
+                        self._suppress_run_summary = False
+                        self._run_summary_telemetry()
+                    return verdict
+                self.run(rounds=1)
+        finally:
+            self._suppress_run_summary = False
+
     def _round_dispatch(self, t: int):
         """Round ``t``'s device dispatch, fully built: ``(fn_name,
         step_fn, args, kwargs, alive, quar, frows, do_eval)``.  The ONE
@@ -1958,7 +2012,8 @@ class GossipTrainer:
                 "matching_rng_state": self._matching_rng.bit_generator.state}
         if self._registry is not None:
             meta["population_registry"] = self._registry.state_dict()
-        save_checkpoint(path, arrays=arrays, meta=meta)
+        save_checkpoint(path, arrays=arrays, meta=meta,
+                        write=self.checkpoint_writer)
 
     def restore(self, path) -> None:
         """Resume from a checkpoint written by ``save`` (same config)."""

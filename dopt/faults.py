@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from typing import Iterable
 
 import numpy as np
 
@@ -64,7 +65,15 @@ _LINK, _UPLINK, _CHURN, _STALE = 5, 6, 7, 8
 
 KINDS = ("crash", "straggler", "partition", "overselect", "corrupt",
          "quarantine", "msg_drop", "msg_delay", "churn", "staleness",
-         "cohort")
+         "cohort", "control")
+# "control" (dopt.serve): one row per APPLIED control-plane command —
+# {round, worker (-1 for fleet-level config/drain/pause rows, the
+# worker id for membership rows), kind: "control", action:
+# "applied_<cmd>_<details>"} — appended at the round boundary the
+# command took effect, BEFORE that round's fault rows, so a served
+# run's ledger is a complete replay script: re-running the base config
+# plus the ledgered commands at their ledgered rounds reproduces the
+# run bit-exactly.
 # "cohort" (dopt.population): one row per population-sampled round —
 # {round, worker: -1, kind: "cohort", action:
 # "sampled_{m}_of_{P}_digest_{crc32}_waves_{K}"} — so which clients a
@@ -111,6 +120,70 @@ class RoundFaults:
                 or (self.corrupt is not None and bool(self.corrupt.any())))
 
 
+class MembershipLog:
+    """Control-plane membership overlay (``dopt.serve``): an ordered
+    log of ``(round, worker, present)`` directives.
+
+    Unlike ``FaultConfig.churn`` — whose leave/join events are random
+    draws — these are COMMANDED transitions: the serve daemon appends
+    one entry per applied ``membership`` command at the round boundary
+    it took effect.  ``away_at(t)`` is a pure function of the log and
+    the round index (the last directive with ``round <= t`` wins per
+    worker), so membership is stateless-per-round exactly like every
+    FaultPlan draw: per-round, blocked, and killed-and-resumed
+    execution see the identical fleet, and a resumed daemon rebuilds
+    the overlay by replaying its applied-command ledger.
+
+    The log rides the EXISTING churn machinery end to end: a departed
+    worker's mixing row is repaired to identity (gossip), it is
+    excluded from sampling (federated), its data shards are
+    deterministically reassigned to the next-alive adopter
+    (``dopt.data.partition.reassign_shards``), and the leave/rejoin/
+    shard-adoption transitions land in the fault ledger as ``churn``
+    rows."""
+
+    def __init__(self, events: Iterable[tuple[int, int, bool]] = ()):
+        self.events: list[tuple[int, int, bool]] = []
+        for r, w, p in events:
+            self.add(r, w, p)
+
+    def add(self, round_idx: int, worker: int, present: bool) -> None:
+        """Append one directive.  Rounds must be nondecreasing — the
+        serve daemon applies commands at successive round boundaries,
+        and a backdated directive would rewrite already-executed
+        rounds' membership."""
+        r, w = int(round_idx), int(worker)
+        if r < 0 or w < 0:
+            raise ValueError(
+                f"membership directive needs round >= 0 and worker >= 0 "
+                f"(got round={r}, worker={w})")
+        if self.events and r < self.events[-1][0]:
+            raise ValueError(
+                f"membership directives must be appended in round order: "
+                f"round {r} after round {self.events[-1][0]}")
+        self.events.append((r, w, bool(present)))
+
+    def away_at(self, t: int, num_workers: int) -> np.ndarray:
+        """[W] bool: workers commanded away as of round ``t``."""
+        away = np.zeros(int(num_workers), bool)
+        for r, w, present in self.events:
+            if r > int(t):
+                break
+            if w < num_workers:
+                away[w] = not present
+        return away
+
+    def to_json(self) -> list[list]:
+        return [[int(r), int(w), bool(p)] for r, w, p in self.events]
+
+    @classmethod
+    def from_json(cls, obj: Iterable) -> "MembershipLog":
+        return cls((int(r), int(w), bool(p)) for r, w, p in obj)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+
 class FaultPlan:
     """Deterministic per-round fault-trace generator for one fleet.
 
@@ -118,10 +191,18 @@ class FaultPlan:
     ``for_round`` returns all-alive states and the engines compile the
     exact pre-fault program.  ``dropout`` is the back-compat alias for
     ``GossipConfig.dropout`` — it synthesizes ``FaultConfig(crash=p)``.
+
+    ``membership`` (``dopt.serve``) arms the commanded-membership
+    overlay: ``away_for_round`` ORs the log's directives into the churn
+    ``away`` set, which flips ``has_churn``/``affects_matrix`` on at
+    construction so the engines compile the elastic program up front —
+    a join/leave command later never retraces.  ``membership=None``
+    (every scripted run) leaves every flag and draw untouched.
     """
 
     def __init__(self, num_workers: int, cfg: FaultConfig | None = None, *,
-                 seed: int = 0, dropout: float = 0.0):
+                 seed: int = 0, dropout: float = 0.0,
+                 membership: MembershipLog | None = None):
         if cfg is not None and dropout > 0.0:
             raise ValueError(
                 "set faults via FaultConfig OR the legacy "
@@ -138,11 +219,20 @@ class FaultPlan:
         self.num_workers = int(num_workers)
         self.seed = (int(cfg.seed) if cfg is not None and cfg.seed is not None
                      else int(seed))
+        self.membership = membership
+        if membership is not None and self.cfg is None:
+            # Arming the overlay makes the plan ACTIVE (departed lanes
+            # must freeze via the fault machinery); an all-zero config
+            # keeps every stochastic draw off — for_round gates each
+            # kind on its probability, so no RNG stream is consumed.
+            self.cfg = FaultConfig()
 
     # -- capability flags (engines key compiled-program shape on these,
     # -- so the fault-free path stays bit-identical to the pre-fault one)
     @property
     def active(self) -> bool:
+        if self.membership is not None:
+            return True
         c = self.cfg
         return c is not None and (c.crash > 0 or c.straggle > 0
                                   or c.partition > 0 or c.corrupt > 0
@@ -160,12 +250,20 @@ class FaultPlan:
         return self.active and self.cfg.corrupt > 0
 
     @property
+    def has_membership(self) -> bool:
+        """Commanded-membership overlay armed (dopt.serve): leave/join
+        directives may repair the matrix / exclude workers at any round
+        boundary, so the elastic machinery compiles in up front."""
+        return self.membership is not None
+
+    @property
     def affects_matrix(self) -> bool:
         """Crash, partition or churn repair can add identity rows to the
         mixing matrix (the shift path must compile shift 0 into its
         set)."""
-        return self.active and (self.cfg.crash > 0 or self.cfg.partition > 0
-                                or self.cfg.churn > 0)
+        return self.has_membership or (
+            self.active and (self.cfg.crash > 0 or self.cfg.partition > 0
+                             or self.cfg.churn > 0))
 
     @property
     def has_link(self) -> bool:
@@ -178,8 +276,11 @@ class FaultPlan:
 
     @property
     def has_churn(self) -> bool:
-        """Elastic-membership leave/join events possible."""
-        return self.active and self.cfg.churn > 0
+        """Elastic-membership leave/join events possible — random
+        (``FaultConfig.churn`` draws) or commanded (the dopt.serve
+        ``MembershipLog`` overlay); both ride the same away/repair/
+        shard-reassignment machinery."""
+        return self.has_membership or (self.active and self.cfg.churn > 0)
 
     @property
     def delay_max(self) -> int:
@@ -307,10 +408,12 @@ class FaultPlan:
         (stateless, resume-exact) and every leave lasts exactly
         ``churn_span`` rounds before the rejoin."""
         w = self.num_workers
-        if not self.has_churn:
-            return np.zeros(w, bool)
-        c = self.cfg
         away = np.zeros(w, bool)
+        if self.membership is not None:
+            away |= self.membership.away_at(t, w)
+        if not (self.active and self.cfg.churn > 0):
+            return away
+        c = self.cfg
         for s in range(int(t), max(int(t) - c.churn_span, -1), -1):
             away |= self._rng(_CHURN, s).random(w) < c.churn
         return away

@@ -145,7 +145,8 @@ class Telemetry:
             s.close()
 
 
-def attach(trainer, telemetry: Telemetry, *, fresh: bool = False) -> Telemetry:
+def attach(trainer, telemetry: Telemetry, *, fresh: bool = False,
+           checkpoint_every: int | None = None) -> Telemetry:
     """Wire a Telemetry into a trainer: sets ``trainer.telemetry``
     (read by the engines' python-gated emission sites), hooks the span
     tracer into the trainer's ``PhaseTimers`` (every existing
@@ -153,7 +154,10 @@ def attach(trainer, telemetry: Telemetry, *, fresh: bool = False) -> Telemetry:
     segment header.  ``fresh=True`` resets the round watermark to 0 —
     for a NEW logical run sharing a sink with earlier ones (bench's
     legs); resumed runs keep the watermark ``to_jsonl(resume=True)``
-    recovered."""
+    recovered.  ``checkpoint_every`` stamps the run's configured
+    checkpoint cadence (rounds) on the header so the monitor's
+    checkpoint_cadence rule knows what to expect without being told
+    out of band."""
     if fresh:
         telemetry.watermark = 0
     trainer.telemetry = telemetry
@@ -169,7 +173,9 @@ def attach(trainer, telemetry: Telemetry, *, fresh: bool = False) -> Telemetry:
                    name=getattr(getattr(trainer, "cfg", None), "name", None)
                    or "run",
                    round=start,
-                   workers=getattr(trainer, "num_workers", None))
+                   workers=getattr(trainer, "num_workers", None),
+                   checkpoint_every=(int(checkpoint_every)
+                                     if checkpoint_every else None))
     return telemetry
 
 

@@ -34,6 +34,17 @@ a ``kind``, and a wall-clock ``ts``.  The kinds:
              are OUTPUT, not replay data, so they stay outside
              ``DETERMINISTIC_KINDS`` (a stream with a monitor attached
              must stay canonically equal to one without).
+``control``  a control-plane command APPLIED at a round boundary
+             (``dopt.serve``): ``cmd`` (config|membership|checkpoint|
+             drain|pause|resume), the boundary ``round``, and the
+             command's payload (``key``/``value`` for config rows,
+             ``worker``/``action`` for membership rows, ``id`` — the
+             queue id — and ``auto: true`` when the daemon
+             self-applied it, e.g. the drop_rate-critical admission
+             pause).  DETERMINISTIC: applied commands are ledgered
+             with their boundary round, so an interrupted-and-resumed
+             served run re-emits exactly the uninterrupted run's
+             control sequence — the stream stays a replay script.
 ``checkpoint`` an auto-checkpoint committed at ``round`` (engines emit
              it after the atomic save lands), optionally carrying a
              ``consensus_distance`` snapshot (params are fetched for
@@ -80,14 +91,18 @@ from typing import Any, Iterable
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning",
-         "alert", "checkpoint", "resource", "compile")
+         "alert", "checkpoint", "resource", "compile", "control")
 
 ALERT_SEVERITIES = ("warn", "critical")
 
 # Kinds whose content is a pure function of the round's host-replay
 # data: streams filtered to these (ts dropped) are bit-identical across
 # per-round / blocked / resumed execution of the same config.
-DETERMINISTIC_KINDS = ("round", "fault", "gauge")
+# ``control`` joins them for served runs: a command is emitted at the
+# ledgered round it was applied, so the same command schedule produces
+# the same control sequence whether or not the daemon was restarted
+# in between (scripted runs simply never carry the kind).
+DETERMINISTIC_KINDS = ("round", "fault", "gauge", "control")
 
 # The per-round convergence diagnostics the engines emit as gauges with
 # ``diagnostics="on"`` (dopt.config), in packed order.  The sixth gauge
@@ -172,6 +187,12 @@ def validate_event(ev: Any) -> dict[str, Any]:
         _req_int(ev, "round")
         if "workers" in ev:
             _req_int(ev, "workers", lo=1)
+        if "checkpoint_every" in ev:
+            # The run's configured checkpoint cadence in rounds (served
+            # runs and --checkpoint-every CLI runs stamp it); the
+            # checkpoint_cadence health rule reads it from here instead
+            # of guessing a default.
+            _req_int(ev, "checkpoint_every")
     elif kind == "round":
         _req_int(ev, "round")
         _req_str(ev, "engine")
@@ -251,6 +272,23 @@ def validate_event(ev: Any) -> dict[str, Any]:
                 _fail("resource live_bytes must be finite >= 0", ev)
         if "source" in ev:
             _req_str(ev, "source")
+    elif kind == "control":
+        _req_int(ev, "round")
+        _req_str(ev, "cmd")
+        if "key" in ev:
+            _req_str(ev, "key")
+        if "action" in ev:
+            _req_str(ev, "action")
+        if "worker" in ev:
+            _req_int(ev, "worker")
+        if "id" in ev:
+            _req_str(ev, "id")
+        if "value" in ev:
+            v = ev["value"]
+            if isinstance(v, float) and not math.isfinite(v):
+                _fail("control value must be finite", ev)
+            if not isinstance(v, (int, float, str, bool)):
+                _fail("control value must be a scalar", ev)
     elif kind == "compile":
         _req_int(ev, "round")
         _req_str(ev, "fn")

@@ -175,10 +175,25 @@ class HealthMonitor(Sink):
                 self.ctx.cohort = None
                 self.ctx.population = None
                 self.ctx.participating = None
+                self.ctx.checkpoint_every = None
                 for r in self.rules:
                     r.reset()
             elif self.segments == 0:
                 self.segments = 1
+            ce = ev.get("checkpoint_every")
+            if isinstance(ce, int) and not isinstance(ce, bool):
+                # The run's configured checkpoint cadence, read by the
+                # checkpoint_cadence rule; resume continuations restate
+                # it, so a restarted tail keeps monitoring the cadence.
+                self.ctx.checkpoint_every = ce
+        elif kind == "control":
+            # A served run's applied control-plane commands: a cadence
+            # change moves the checkpoint_cadence rule's expectation
+            # from the boundary it was applied.
+            if (ev.get("cmd") == "config"
+                    and ev.get("key") == "checkpoint_every"
+                    and isinstance(ev.get("value"), (int, float))):
+                self.ctx.checkpoint_every = int(ev["value"]) or None
         elif kind == "round":
             self.rounds_seen += 1
             self.ctx.round = int(ev["round"])
@@ -266,6 +281,7 @@ class HealthMonitor(Sink):
                     "cohort": self.ctx.cohort,
                     "population": self.ctx.population,
                     "participating": self.ctx.participating,
+                    "checkpoint_every": self.ctx.checkpoint_every,
                     "round": self.ctx.round},
             "rules": {r.name: json.loads(json.dumps(r.s))
                       for r in self.rules},
@@ -285,6 +301,7 @@ class HealthMonitor(Sink):
         self.ctx.cohort = ctx.get("cohort")
         self.ctx.population = ctx.get("population")
         self.ctx.participating = ctx.get("participating")
+        self.ctx.checkpoint_every = ctx.get("checkpoint_every")
         self.ctx.round = int(ctx.get("round", -1))
         saved = st.get("rules", {})
         for r in self.rules:

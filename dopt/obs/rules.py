@@ -65,6 +65,11 @@ class RunContext:
         self.cohort: float | None = None       # population cohort_size gauge
         self.population: float | None = None   # population_size gauge
         self.participating: float | None = None  # participating_lanes gauge
+        # Configured checkpoint cadence in rounds: stamped on the run
+        # header (serve daemons and --checkpoint-every runs) and
+        # updated live by control events that change it — the
+        # checkpoint_cadence rule's expected-cadence source.
+        self.checkpoint_every: int | None = None
         self.round: int = -1
 
     def denominator(self) -> float | None:
@@ -549,11 +554,18 @@ class HostGapRule(Rule):
 
 
 class CheckpointCadenceRule(Rule):
-    """A run configured to checkpoint every K rounds went ``every`` +
+    """A run configured to checkpoint every K rounds went K +
     ``slack`` rounds without a ``checkpoint`` event — the crash-exact
-    resume guarantee is silently eroding.  Inactive unless ``every``
-    is set (checkpoint timing is call-pattern state, not something a
-    default rule can guess)."""
+    resume guarantee is silently eroding.
+
+    The expected cadence comes from the RUN ITSELF: the ``run``
+    segment header's ``checkpoint_every`` field (serve daemons and
+    ``--checkpoint-every`` CLI runs stamp it) or a ``control`` event
+    that changes it mid-run, both tracked in ``ctx.checkpoint_every``.
+    An explicit ``every=`` construction kwarg overrides the stream's
+    claim (the operator knows better); with neither, the rule is
+    inactive — checkpoint timing is call-pattern state, not something
+    a default rule can guess."""
 
     name = "checkpoint_cadence"
     severity = "warn"
@@ -567,7 +579,9 @@ class CheckpointCadenceRule(Rule):
         self.s = {"armed": True, "last": None, "start": None}
 
     def update(self, ev: dict, ctx: RunContext) -> list[dict]:
-        if self.every is None:
+        every = self.every if self.every is not None \
+            else ctx.checkpoint_every
+        if not every:
             return []
         kind = ev.get("kind")
         if kind == "checkpoint":
@@ -580,11 +594,11 @@ class CheckpointCadenceRule(Rule):
             self.s["start"] = t
         anchor = self.s["last"] if self.s["last"] is not None \
             else self.s["start"] - 1
-        overdue = t - anchor > self.every + self.slack
+        overdue = t - anchor > every + self.slack
         if self.edge(overdue):
             return [{"round": t,
                      "message": f"no checkpoint for {t - anchor} rounds "
-                                f"(expected every {self.every})"}]
+                                f"(expected every {every})"}]
         return []
 
 

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -135,23 +137,57 @@ def main(argv: list[str] | None = None) -> int:
                     help="telemetry stream to tail (may not exist yet — "
                          "the tail waits for it)")
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--port", type=int, default=8000,
+                    help="bind port; 0 binds an EPHEMERAL port — the "
+                         "chosen one is announced on stdout (one JSON "
+                         "line) and in --state-file, so soak harnesses "
+                         "and embedding daemons never fixed-port race")
     ap.add_argument("--workers", type=int, default=None,
                     help="fleet-size denominator override for rules "
                          "(normally recovered from the stream's run "
                          "header)")
+    ap.add_argument("--state-file", default=None, metavar="PATH",
+                    help="write {host, port, pid, metrics} here "
+                         "(atomically) once bound; removed on clean "
+                         "shutdown")
     args = ap.parse_args(argv)
 
     server = MetricsServer(args.metrics, host=args.host, port=args.port,
                            workers=args.workers)
+    # The bound port goes to STDOUT as one JSON line (stderr keeps the
+    # human banner): `PORT=$(... | head -1 | jq .port)` just works,
+    # including under --port 0.
+    print(json.dumps({"host": args.host, "port": server.port,
+                      "metrics": str(args.metrics), "pid": os.getpid()}),
+          flush=True)
+    if args.state_file:
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(args.state_file, json.dumps(
+            {"host": args.host, "port": server.port, "pid": os.getpid(),
+             "metrics": str(args.metrics)}, indent=2))
     print(f"serving {args.metrics} on http://{args.host}:{server.port} "
           f"(/metrics, /healthz)", file=sys.stderr)
+
+    def _term(signum, frame):
+        # Graceful SIGTERM: unwind through the KeyboardInterrupt path
+        # so the finally block closes the socket and removes the state
+        # file — embedding daemons and soak harnesses can stop the
+        # endpoint without leaking the port.
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.shutdown()
+        if args.state_file:
+            try:
+                os.unlink(args.state_file)
+            except OSError:
+                pass
     return 0
 
 

@@ -106,10 +106,12 @@ class JsonlSink(Sink):
         event is complete and only the terminator was torn — and
         dropped when it does not (once appended events follow it the
         garbage would sit MID-file, where ``read`` rightly raises).
-        Then any trailing complete ``fault``/``gauge`` events whose
-        round was never sealed by a ``round`` event are dropped: the
-        resumed run re-emits that round's whole bundle, so keeping the
-        orphans would silently double-count faults.  Every decision is
+        Then any trailing complete ``fault``/``gauge``/``control``
+        events whose round was never sealed by a ``round`` event are
+        dropped: the resumed run re-emits that round's whole bundle
+        (and the serve daemon re-emits the resume boundary's applied
+        control events), so keeping the orphans would silently
+        double-count faults or duplicate control records.  Every decision is
         made against the repaired bytes, so the watermark
         ``scan_watermark`` recovers (before OR after the repair) always
         agrees with what survives on disk."""
@@ -145,7 +147,7 @@ class JsonlSink(Sink):
                     ev = json.loads(line)
                 except ValueError:
                     break
-                if not (ev.get("kind") in ("fault", "gauge")
+                if not (ev.get("kind") in ("fault", "gauge", "control")
                         and isinstance(ev.get("round"), int)
                         and ev["round"] > sealed):
                     break

@@ -153,14 +153,28 @@ def shard_worker_tree(tree, mesh: Mesh):
     """Place a stacked [W, ...] pytree with the worker axis sharded.
 
     W must divide evenly by the mesh size (pad the worker count or pick
-    a divisor worker total — the engine validates this upstream)."""
+    a divisor worker total — the engine validates this upstream).
+
+    On a multi-process fleet (``dopt serve``) the placement goes
+    through ``make_array_from_callback``: every process holds the FULL
+    host array (checkpoint restores read the same file), so each can
+    slice out its addressable shards locally — zero collectives.  A
+    bare ``device_put`` against a non-addressable sharding would run a
+    cross-process ``assert_equal`` broadcast PER LEAF, a pile of tiny
+    gloo collectives on the restore path that the tcp transport's
+    message-interleave race loves."""
     sh = worker_sharding(mesh)
+    multiprocess = jax.process_count() > 1
 
     def put(x):
         if x.shape[0] % mesh.size:
             raise ValueError(
                 f"worker axis {x.shape[0]} not divisible by mesh size {mesh.size}"
             )
+        if multiprocess:
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sh, lambda idx: x[idx])
         return jax.device_put(x, sh)
 
     return jax.tree.map(put, tree)

@@ -26,7 +26,11 @@ full multi-host code path without a cluster (SURVEY §4's answer to
 
 from __future__ import annotations
 
+import json
 import os
+import socket
+import time
+from pathlib import Path
 
 import jax
 import numpy as np
@@ -34,6 +38,98 @@ from jax.sharding import Mesh
 
 HOST_AXIS = "hosts"   # slow axis: crosses DCN on a real multi-slice job
 ICI_AXIS = "ici"      # fast axis: stays on-slice
+
+
+def pick_ephemeral_port(host: str = "127.0.0.1") -> int:
+    """Bind port 0, read back the kernel's choice, release it."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def write_handoff(path: str | Path, address: str) -> None:
+    """Publish the coordinator address atomically (tmp + rename): a
+    waiter never reads a half-written file."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps({"coordinator": address}))
+    os.replace(tmp, path)
+
+
+def wait_handoff(path: str | Path, *, poll_s: float = 0.05,
+                 max_polls: int = 2400) -> str:
+    """Poll until the handoff file appears; returns the coordinator
+    address.  Bounded by poll COUNT (default ~2 minutes at 50 ms) so
+    an orphaned waiter fails loudly instead of hanging forever."""
+    path = Path(path)
+    for _ in range(max_polls):
+        if path.exists():
+            try:
+                return str(json.loads(path.read_text())["coordinator"])
+            except (ValueError, KeyError):
+                pass   # racing the rename of a stale tmp: retry
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"no coordinator handoff at {path} after {max_polls} polls "
+        "(did process 0 die before binding?)")
+
+
+def bootstrap_child_backend(handoff_path: str | Path, process_id: int,
+                            num_processes: int, devices_per_proc: int, *,
+                            host: str = "127.0.0.1",
+                            collectives: str = "gloo") -> str:
+    """The ONE fleet-child jax bootstrap, shared by
+    ``scripts/multiprocess_demo.py`` and ``python -m dopt.serve``:
+    REPLACE any inherited virtual-device-count flag (test harnesses
+    export their own N and last-one-wins is not contractual), pin the
+    CPU platform + collectives implementation before the backend
+    initialises, rendezvous on the port-0 handoff coordinator, wire
+    ``jax.distributed``, and sanity-check the resulting process/device
+    topology.  Returns the coordinator address.  Must run before
+    anything touches a jax backend in this process."""
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count="
+        f"{devices_per_proc}")
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", collectives)
+    address = coordinator_handoff(handoff_path, process_id, host=host)
+    if not initialize_distributed(address, num_processes, process_id):
+        raise RuntimeError(
+            "initialize_distributed returned False with explicit args")
+    if jax.process_count() != num_processes:
+        raise RuntimeError(
+            f"expected {num_processes} processes, backend reports "
+            f"{jax.process_count()}")
+    if jax.local_device_count() != devices_per_proc:
+        raise RuntimeError(
+            f"expected {devices_per_proc} local devices, backend "
+            f"reports {jax.local_device_count()}")
+    return address
+
+
+def coordinator_handoff(path: str | Path, process_id: int, *,
+                        host: str = "127.0.0.1",
+                        poll_s: float = 0.05,
+                        max_polls: int = 2400) -> str:
+    """Ephemeral-port coordinator bootstrap for multi-process CPU
+    fleets: process 0 picks a port-0 ephemeral port IN ITS OWN PROCESS
+    and publishes ``host:port`` through an atomic handoff file; every
+    other process waits on the file.  This replaces the parent-probed
+    fixed-port scheme whose bind raced everything on the machine for
+    the whole child-interpreter startup (seconds) — the remaining
+    TOCTOU window is the microseconds between the probe socket closing
+    and the coordinator's gRPC server binding, inside one process."""
+    path = Path(path)
+    if int(process_id) == 0:
+        address = f"{host}:{pick_ephemeral_port(host)}"
+        write_handoff(path, address)
+        return address
+    return wait_handoff(path, poll_s=poll_s, max_polls=max_polls)
 
 
 def _distributed_initialized() -> bool:

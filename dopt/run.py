@@ -320,7 +320,8 @@ def main(argv: list[str] | None = None) -> int:
         tele = (Telemetry.to_jsonl(args.metrics_out,
                                    resume=bool(args.resume))
                 if args.metrics_out else Telemetry())
-        attach(trainer, tele)
+        attach(trainer, tele,
+               checkpoint_every=args.checkpoint_every or None)
 
     rounds = args.rounds
     if rounds is None:
@@ -347,15 +348,6 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote XLA trace to {args.trace}", file=sys.stderr)
     else:
         trainer.run(rounds=rounds, **run_kw)
-    if tele is not None:
-        tele.close()
-        if args.metrics_out:
-            print(f"wrote telemetry stream to {args.metrics_out}",
-                  file=sys.stderr)
-        if args.trace_out:
-            tele.write_trace(args.trace_out)
-            print(f"wrote host span trace to {args.trace_out}",
-                  file=sys.stderr)
     for row in trainer.history.rows[-min(rounds, len(trainer.history)):]:
         print(json.dumps(row))
     print(f"total_time_s={trainer.total_time:.2f}", file=sys.stderr)
@@ -374,6 +366,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.checkpoint:
         trainer.save(args.checkpoint)
         print(f"checkpointed to {args.checkpoint}", file=sys.stderr)
+    if tele is not None:
+        # Closed AFTER the final --checkpoint save: the engines emit a
+        # `checkpoint` telemetry event when a save lands, and a closed
+        # sink would turn the last one into an I/O error.
+        tele.close()
+        if args.metrics_out:
+            print(f"wrote telemetry stream to {args.metrics_out}",
+                  file=sys.stderr)
+        if args.trace_out:
+            tele.write_trace(args.trace_out)
+            print(f"wrote host span trace to {args.trace_out}",
+                  file=sys.stderr)
     return 0
 
 

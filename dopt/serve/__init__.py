@@ -1,0 +1,44 @@
+"""dopt.serve — the resident elastic trainer with a live control plane.
+
+The ROADMAP's "production service mode": one long-lived elastic run
+instead of N scripted rounds.  ``python -m dopt.serve --preset
+baseline1 --state-dir run/`` owns a training loop until told
+otherwise, and everything that happens to it mid-flight — membership
+join/leave, whitelisted config changes, checkpoints, admission pauses,
+drains — arrives through a versioned command queue, applies at a round
+boundary, and is ledgered (fault-ledger ``control`` rows + the
+deterministic ``control`` telemetry kind), so a served run stays a
+pure function of (base config, applied-command ledger): interruptible,
+resumable, and bit-reproducible.
+
+Layers (one module each):
+
+* ``dopt.serve.control`` — command schema, append-only JSONL queue,
+  applied-command ledger (the replay source), config whitelist;
+* ``dopt.serve.daemon``  — ``ServeDaemon``: the round-boundary
+  controller behind the engines' ``run_served`` entry, streaming
+  checkpoints, the in-process ``HealthMonitor`` (alerts feed back:
+  drop_rate-critical auto-pauses admission), SIGTERM → drain →
+  checkpoint → re-exec → bit-exact resume, and the leader/follower
+  directive barrier for multi-process fleets;
+* ``dopt.serve.admin``   — the stdlib HTTP surface: ``/admin/*``
+  command endpoints plus the in-process ``/metrics`` + ``/healthz``;
+* ``dopt.serve.__main__`` — the CLI: single-process daemon,
+  self-re-exec on SIGTERM, and the multi-process supervisor that grows
+  ``scripts/multiprocess_demo.py`` into the supported
+  ``jax.distributed`` path.
+"""
+
+from __future__ import annotations
+
+from dopt.serve.control import (COMMANDS, CONFIG_WHITELIST, CommandQueue,
+                                ControlLedger, make_command,
+                                validate_command)
+from dopt.serve.daemon import (EX_RESTART, ServeDaemon, build_serve_trainer,
+                               serve_rules)
+
+__all__ = [
+    "COMMANDS", "CONFIG_WHITELIST", "CommandQueue", "ControlLedger",
+    "EX_RESTART", "ServeDaemon", "build_serve_trainer", "make_command",
+    "serve_rules", "validate_command",
+]

@@ -1,0 +1,662 @@
+"""The resident trainer daemon (``python -m dopt.serve``).
+
+``ServeDaemon`` owns a training loop indefinitely instead of for
+``--rounds N``: the engines' ``run_served`` entry calls back into
+``boundary()`` before every round, where the daemon
+
+1. **ingests** new control-plane commands (``dopt.serve.control``) and
+   applies the due ones — membership join/leave through the
+   ``MembershipLog`` → churn/shard-reassignment machinery, whitelisted
+   config changes through checkpoint → rebuild → restore, cadence /
+   pause / drain in place — each application ledgered as a
+   ``control`` fault-ledger row AND a deterministic ``control``
+   telemetry event at the boundary round;
+2. **checkpoints** on a round cadence (and at every boundary that
+   applied a command, so the applied ledger never gets ahead of the
+   training state) through the existing atomic size-manifest format;
+3. **watches itself**: the PR 10 ``HealthMonitor`` rides the telemetry
+   fan-out IN-PROCESS (no file tailing), its state checkpointed next
+   to the trainer so a restarted daemon resumes the rule windows
+   mid-stream, and a ``drop_rate``-critical alert auto-pauses
+   admission (join commands are rejected until a ``resume``);
+4. **survives restarts**: SIGTERM → drain to the boundary →
+   checkpoint → hand back for re-exec → bit-exact resume.  The run is
+   a pure function of (base config, applied-command ledger), so an
+   interrupted-and-resumed serve produces History, fault ledger and
+   canonical telemetry identical to an uninterrupted one.
+
+Multi-process fleets (real ``jax.distributed`` process groups — the
+grown-up ``scripts/multiprocess_demo.py``) run one daemon per process:
+process 0 is the **leader** (owns the queue, telemetry, admin
+endpoint, checkpoint writes), followers replay the leader's published
+per-boundary directive so every process applies the same commands at
+the same round — the coordinator-led config/epoch barrier.  Fleet
+checkpoints cross-process-allgather the sharded state (a collective
+every process joins) with a single writer.  A SIGTERM to ANY process
+requests a rolling restart: the fleet quiesces at the next boundary,
+checkpoints once, every process re-execs, and training resumes
+bit-exactly on a fresh coordinator — SPMD collectives make per-host
+independence cooperative, so "one host at a time" means the run
+survives each host's restart in turn, not that collectives proceed
+through it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from dopt.serve.control import (CommandQueue, ControlLedger,
+                                apply_config_change, applied_record,
+                                control_event_fields, control_ledger_row,
+                                make_command, replay_effects)
+
+# Exit code meaning "re-exec me" (BSD EX_TEMPFAIL — the conventional
+# try-again code): the supervisor (or the shell loop in the README)
+# respawns the daemon with the same state dir and it resumes.
+EX_RESTART = 75
+
+_STATUS_FILE = "serve.json"
+_FINAL_FILE = "final.json"
+_MONITOR_FILE = "monitor.json"
+_COMMANDS_FILE = "commands.jsonl"
+_APPLIED_FILE = "applied.jsonl"
+_METRICS_FILE = "metrics.jsonl"
+_CKPT_DIR = "ckpt"
+_EPOCH_DIR = "epoch"
+_RESTART_FLAG = "restart-requested"
+
+
+def build_serve_trainer(cfg, membership):
+    """Construct the engine for a served run with the membership
+    overlay armed (the elastic program compiles up front — a later
+    join/leave never retraces)."""
+    if cfg.backend != "jax" or cfg.seqlm is not None:
+        raise ValueError(
+            "dopt serve drives the federated/gossip jax engines only "
+            "(the torch oracle and the seqlm engine have no serve "
+            "entry)")
+    from dopt.engine import FederatedTrainer, GossipTrainer
+
+    if cfg.federated is not None:
+        return FederatedTrainer(cfg, membership=membership)
+    return GossipTrainer(cfg, membership=membership)
+
+
+class _LockedPrometheusSink:
+    """PrometheusSink behind an RLock: the admin thread renders while
+    the training thread emits."""
+
+    def __init__(self):
+        from dopt.obs.sinks import PrometheusSink
+
+        self._prom = PrometheusSink()
+        self._lock = threading.RLock()
+
+    def emit(self, event):
+        with self._lock:
+            self._prom.emit(event)
+
+    def emit_many(self, events):
+        with self._lock:
+            for ev in events:
+                self._prom.emit(ev)
+
+    def render(self) -> str:
+        with self._lock:
+            return self._prom.render()
+
+    def close(self):
+        pass
+
+
+def serve_rules(extra_drop_rate: float = 0.5):
+    """The daemon's stock rule set: ``default_rules()`` plus an
+    ESCALATED drop-rate instance at critical severity — the signal the
+    admission auto-pause keys on.  The escalation threshold (lost
+    contributions per participant-round) is far above anything a
+    healthy fleet produces, so the clean-run false-positive gate still
+    holds."""
+    from dopt.obs.rules import DropRateRule, default_rules
+
+    rules = default_rules()
+    esc = DropRateRule(max_rate=float(extra_drop_rate), window=4,
+                       min_rounds=2)
+    esc.name = "drop_rate_critical"
+    esc.severity = "critical"
+    rules.append(esc)
+    return rules
+
+
+class ServeDaemon:
+    """One resident trainer + its control plane.  ``start()`` builds
+    (or resumes) everything, ``serve()`` runs until drained or told to
+    restart; the instance itself is the ``run_served`` controller."""
+
+    def __init__(self, cfg, state_dir, *, checkpoint_every: int = 8,
+                 max_rounds: int | None = None, on_term: str = "restart",
+                 admin_host: str = "127.0.0.1",
+                 admin_port: int | None = None,
+                 rules=None, process_id: int = 0, num_processes: int = 1,
+                 directive_poll_s: float = 0.05,
+                 directive_max_polls: int = 12000):
+        if on_term not in ("restart", "drain"):
+            raise ValueError(
+                f"on_term must be 'restart' or 'drain', got {on_term!r}")
+        self.base_cfg = cfg
+        self.cfg = cfg
+        self.state_dir = Path(state_dir)
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_rounds = max_rounds
+        self.on_term = on_term
+        self.admin_host = admin_host
+        self.admin_port = admin_port
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.is_leader = self.process_id == 0
+        self._rules = rules
+        self._directive_poll_s = float(directive_poll_s)
+        self._directive_max_polls = int(directive_max_polls)
+
+        self.queue = CommandQueue(self.state_dir / _COMMANDS_FILE)
+        self.ledger = ControlLedger(self.state_dir / _APPLIED_FILE)
+        self.ckpt_path = self.state_dir / _CKPT_DIR
+        self.metrics_path = self.state_dir / _METRICS_FILE
+
+        self.trainer = None
+        self.telemetry = None
+        self.monitor = None
+        self.prom = None
+        self.admin = None
+        self.membership = None
+        self.paused = False
+        self.restarts = 0
+        self.status = "starting"
+        self._pending: list[dict[str, Any]] = []
+        self._processed: set[str] = set()
+        self._term = False
+        self._term_signal: str | None = None
+        self._last_ckpt = -1
+        self._alerts_seen = 0
+        self._resumed = False
+        # Per-process boundary visit counter: a config-change rebuild
+        # REVISITS the same round boundary, so directives are keyed by
+        # (visit sequence, round), never round alone — SPMD lockstep
+        # means every process counts visits identically, and the
+        # supervisor wipes the directive dir between generations.
+        self._boundary_seq = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "ServeDaemon":
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        resume_round = self._peek_checkpoint_round()
+        records = ControlLedger.replay(self.state_dir / _APPLIED_FILE)
+        effects = replay_effects(
+            records, up_to_round=resume_round if resume_round is not None
+            else -1)
+        from dopt.faults import MembershipLog
+
+        self.membership = MembershipLog(effects["membership"])
+        cfg = self.base_cfg
+        for _, key, value in effects["config"]:
+            cfg = apply_config_change(cfg, key, value)
+        self.cfg = cfg
+        if effects["checkpoint_every"] is not None:
+            self.checkpoint_every = int(effects["checkpoint_every"])
+        self.paused = bool(effects["paused"])
+        self._processed = set(effects["processed"])
+        self.restarts = int(self._read_status_field("restarts", 0))
+
+        self.trainer = build_serve_trainer(self.cfg, self.membership)
+        if not self.is_leader:
+            self.trainer.checkpoint_writer = False
+        if resume_round is not None:
+            self.trainer.restore(self.ckpt_path)
+            self._resumed = True
+            self.restarts += 1
+        self._last_ckpt = int(self.trainer.round) if self._resumed else -1
+
+        if self.is_leader:
+            from dopt.obs import HealthMonitor, Telemetry, attach
+
+            self.telemetry = Telemetry.to_jsonl(self.metrics_path,
+                                                resume=True)
+            stream_watermark = self.telemetry.watermark
+            self.prom = _LockedPrometheusSink()
+            self.telemetry.sinks.append(self.prom)
+            mon_state = None
+            mpath = self.state_dir / _MONITOR_FILE
+            if self._resumed and mpath.exists():
+                try:
+                    mon_state = json.loads(mpath.read_text())
+                except ValueError:
+                    mon_state = None   # torn by a hard kill: start fresh
+            self.monitor = HealthMonitor(
+                self._rules if self._rules is not None else serve_rules(),
+                workers=self.trainer.num_workers, state=mon_state)
+            self.monitor.attach(self.telemetry)
+            self._alerts_seen = len(self.monitor.alerts)
+            attach(self.trainer, self.telemetry,
+                   checkpoint_every=self.checkpoint_every or None)
+            if self._resumed and stream_watermark <= int(self.trainer.round):
+                # Commands applied at EXACTLY the resume boundary may
+                # have lost their control events: the event trails the
+                # last sealed round, so repair_tail can drop it on
+                # reopen (and a kill window can lose it outright) —
+                # while one shielded by a later non-droppable event
+                # (e.g. the boundary's `checkpoint`) survives.  Re-emit
+                # exactly the MISSING ones, by id, so the resumed
+                # stream carries each applied command once.
+                r = int(self.trainer.round)
+                present = self._stream_control_ids(r)
+                for rec in records:
+                    if rec.get("status") == "applied" \
+                            and int(rec.get("round", -1)) == r \
+                            and str(rec.get("id")) not in present:
+                        self.telemetry.emit(
+                            "control",
+                            **control_event_fields(
+                                rec, r, auto=bool(rec.get("auto"))))
+            if self.admin_port is not None:
+                from dopt.serve.admin import AdminServer
+
+                self.admin = AdminServer(self, host=self.admin_host,
+                                         port=self.admin_port).start()
+        self._install_signals()
+        self.status = "serving"
+        self._write_status()
+        return self
+
+    def _stream_control_ids(self, round_idx: int) -> set[str]:
+        """Ids of ``control`` events at ``round_idx`` already in the
+        metrics stream (post ``repair_tail``).  One linear scan at
+        startup; the substring pre-filter keeps it cheap on long
+        streams."""
+        ids: set[str] = set()
+        if not self.metrics_path.exists():
+            return ids
+        with open(self.metrics_path, encoding="utf-8") as f:
+            for line in f:
+                if '"control"' not in line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("kind") == "control" \
+                        and ev.get("round") == round_idx:
+                    ids.add(str(ev.get("id")))
+        return ids
+
+    def _peek_checkpoint_round(self) -> int | None:
+        """The complete checkpoint's round, or None when starting
+        fresh — read via the same completeness/fallback logic a
+        restore would use."""
+        from dopt.utils.checkpoint import (IncompleteCheckpointError,
+                                           load_checkpoint)
+
+        if not self.ckpt_path.exists() and not self.ckpt_path.with_name(
+                self.ckpt_path.name + ".old").exists():
+            return None
+        try:
+            _, meta = load_checkpoint(self.ckpt_path)
+        except IncompleteCheckpointError:
+            return None
+        return int(meta["round"])
+
+    def _read_status_field(self, key: str, default):
+        p = self.state_dir / _STATUS_FILE
+        if not p.exists():
+            return default
+        try:
+            return json.loads(p.read_text()).get(key, default)
+        except ValueError:
+            return default
+
+    def _install_signals(self) -> None:
+        def _term(signum, frame):
+            self._term = True
+            self._term_signal = ("drain" if signum == signal.SIGINT
+                                 else self.on_term)
+            if not self.is_leader:
+                # A follower cannot decide for the fleet: it files a
+                # stop request (carrying WHICH stop — SIGINT drains,
+                # SIGTERM follows --on-term) that the leader folds
+                # into the next boundary's directive.
+                try:
+                    (self.state_dir / _RESTART_FLAG).write_text(
+                        self._term_signal)
+                except OSError:
+                    pass
+
+        signal.signal(signal.SIGTERM, _term)
+        signal.signal(signal.SIGINT, _term)
+
+    # -- the run_served controller ------------------------------------
+    def boundary(self, trainer) -> str:
+        t = int(trainer.round)
+        self._boundary_seq += 1
+        if self.num_processes > 1 and not self.is_leader:
+            directive = self._await_directive(self._boundary_seq, t)
+        else:
+            directive = self._decide(t, trainer)
+            if self.num_processes > 1:
+                self._publish_directive(self._boundary_seq, directive)
+        return self._execute(directive, trainer)
+
+    def _decide(self, t: int, trainer) -> dict[str, Any]:
+        """Leader: resolve this boundary completely (what applies, what
+        is rejected, whether to checkpoint/stop/rebuild) so followers
+        can replay the decision verbatim."""
+        commands, malformed = self.queue.poll()
+        for rej in malformed:
+            if rej["id"] in self._processed:
+                continue
+            self._processed.add(rej["id"])
+            self.ledger.append({"v": 1, "id": rej["id"],
+                                "cmd": rej.get("cmd"),
+                                "status": "rejected", "round": t,
+                                "reason": rej["reason"]})
+        for c in commands:
+            if c["id"] not in self._processed:
+                self._pending.append(c)
+
+        due = [c for c in self._pending
+               if c.get("at_round") is None or int(c["at_round"]) <= t]
+        applied: list[dict[str, Any]] = []
+        rejected: list[dict[str, Any]] = []
+        auto_ids: list[str] = []
+        stop: str | None = None
+        paused = self.paused
+        for c in due:
+            cmd = c["cmd"]
+            if cmd == "membership":
+                if int(c["worker"]) >= trainer.num_workers:
+                    rejected.append(applied_record(
+                        c, status="rejected", round_idx=t,
+                        reason=f"worker {c['worker']} outside the "
+                               f"provisioned {trainer.num_workers}-lane "
+                               "fleet"))
+                    continue
+                if c["action"] == "join" and paused:
+                    rejected.append(applied_record(
+                        c, status="rejected", round_idx=t,
+                        reason="admission paused (resume to re-open)"))
+                    continue
+            if cmd == "drain":
+                stop = "restart" if c.get("restart") else "drain"
+            if cmd == "pause":
+                paused = True
+            if cmd == "resume":
+                paused = False
+            applied.append(c)
+
+        # drop_rate-critical auto-pause: the monitor's alerts are
+        # deterministic over the stream, so the pause lands at the same
+        # boundary in an interrupted and an uninterrupted run.
+        if self.monitor is not None and not paused:
+            fresh = self.monitor.alerts[self._alerts_seen:]
+            if any(a.get("severity") == "critical"
+                   and str(a.get("rule", "")).startswith("drop_rate")
+                   for a in fresh):
+                c = make_command("pause", id=f"auto-pause-{t}")
+                applied.append(c)
+                auto_ids.append(c["id"])
+        if self.monitor is not None:
+            self._alerts_seen = len(self.monitor.alerts)
+
+        if self._term:
+            stop = stop or self._term_signal or self.on_term
+        flag = self.state_dir / _RESTART_FLAG
+        if flag.exists():
+            try:
+                requested = flag.read_text().strip()
+            except OSError:
+                requested = "restart"
+            stop = stop or (requested if requested in ("restart", "drain")
+                            else "restart")
+        if stop is None and self.max_rounds is not None \
+                and t >= int(self.max_rounds):
+            stop = "drain"
+
+        rebuild = any(c["cmd"] == "config" and c["key"] != "checkpoint_every"
+                      for c in applied)
+        cadence = (self.checkpoint_every and t > 0
+                   and t % self.checkpoint_every == 0
+                   and t != self._last_ckpt)
+        checkpoint = bool(applied) or bool(cadence) or stop is not None \
+            or rebuild
+        if t == 0 and not applied and stop is None:
+            checkpoint = False   # nothing to persist before round 0
+        return {"round": t, "apply": applied, "rejected": rejected,
+                "auto": auto_ids, "stop": stop, "rebuild": rebuild,
+                "checkpoint": checkpoint}
+
+    def _execute(self, directive: dict[str, Any], trainer) -> str:
+        t = int(directive["round"])
+        done_ids = set()
+        if self.is_leader:
+            for rec in directive["rejected"]:
+                self.ledger.append(rec)
+                self._processed.add(str(rec.get("id")))
+                done_ids.add(str(rec.get("id")))
+        for c in directive["apply"]:
+            auto = c.get("id") in directive.get("auto", ())
+            trainer.history.faults.append(control_ledger_row(c, t))
+            self._install_effect(c, t)
+            if self.is_leader:
+                self.ledger.append(applied_record(c, status="applied",
+                                                  round_idx=t, auto=auto))
+                self._processed.add(str(c["id"]))
+                if self.telemetry is not None:
+                    self.telemetry.emit(
+                        "control", **control_event_fields(c, t, auto=auto))
+            done_ids.add(str(c.get("id")))
+        if done_ids:
+            self._pending = [c for c in self._pending
+                             if str(c.get("id")) not in done_ids]
+
+        if directive["checkpoint"]:
+            self._checkpoint(trainer, t)
+        stop = directive["stop"]
+        if stop is not None:
+            self.status = ("draining" if stop == "drain" else "restarting")
+        self._write_status(round_=t)
+        if stop is not None:
+            return stop
+        if directive["rebuild"]:
+            return "rebuild"
+        return "run"
+
+    def _install_effect(self, c: dict[str, Any], t: int) -> None:
+        cmd = c["cmd"]
+        if cmd == "config":
+            if c["key"] == "checkpoint_every":
+                self.checkpoint_every = int(c["value"])
+            else:
+                self.cfg = apply_config_change(self.cfg, c["key"],
+                                               c["value"])
+        elif cmd == "membership":
+            self.membership.add(t, int(c["worker"]),
+                                c["action"] == "join")
+        elif cmd == "pause":
+            self.paused = True
+        elif cmd == "resume":
+            self.paused = False
+        # checkpoint/drain effects are carried by the directive itself.
+
+    def _checkpoint(self, trainer, t: int) -> None:
+        trainer.save(self.ckpt_path)
+        if self.num_processes > 1:
+            # The save's allgather is collective; the barrier keeps
+            # followers from racing ahead (a rebuild's restore must
+            # not read a checkpoint the leader is still writing).
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"dopt-serve-ckpt-{t}")
+        if self.is_leader and self.monitor is not None:
+            from dopt.utils.metrics import atomic_write_text
+
+            atomic_write_text(self.state_dir / _MONITOR_FILE,
+                              json.dumps(self.monitor.state()))
+        self._last_ckpt = t
+
+    def _write_status(self, round_: int | None = None) -> None:
+        if not self.is_leader:
+            return
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(self.state_dir / _STATUS_FILE, json.dumps({
+            "pid": os.getpid(),
+            "round": int(round_ if round_ is not None
+                         else getattr(self.trainer, "round", 0)),
+            "status": self.status,
+            "paused": self.paused,
+            "checkpoint_every": self.checkpoint_every,
+            "restarts": self.restarts,
+            "admin_port": self.admin.port if self.admin else None,
+            "num_processes": self.num_processes,
+            "metrics": str(self.metrics_path),
+        }, indent=2))
+
+    # -- multi-process directives --------------------------------------
+    def _directive_path(self, seq: int, t: int) -> Path:
+        # Keyed by (visit sequence, round): a rebuild revisits the same
+        # round, and a round-only key would let a follower re-read the
+        # stale pre-rebuild directive and double-apply it.
+        return self.state_dir / _EPOCH_DIR / f"{seq:06d}-{t}.json"
+
+    def _publish_directive(self, seq: int,
+                           directive: dict[str, Any]) -> None:
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(self._directive_path(seq, directive["round"]),
+                          json.dumps(directive))
+
+    def _await_directive(self, seq: int, t: int) -> dict[str, Any]:
+        path = self._directive_path(seq, t)
+        for _ in range(self._directive_max_polls):
+            if path.exists():
+                try:
+                    return json.loads(path.read_text())
+                except ValueError:
+                    pass   # racing the rename: retry
+            time.sleep(self._directive_poll_s)
+        raise RuntimeError(
+            f"process {self.process_id}: no boundary directive for round "
+            f"{t} (visit {seq}) after {self._directive_max_polls} polls "
+            "— leader gone?")
+
+    # -- the serve loop ------------------------------------------------
+    def serve(self) -> int:
+        """Run until drained (returns 0) or told to restart (returns
+        ``EX_RESTART`` — the caller re-execs or the supervisor
+        respawns)."""
+        while True:
+            verdict = self.trainer.run_served(self)
+            if verdict == "rebuild":
+                self._rebuild()
+                continue
+            if verdict == "drain":
+                self._finalize("drained")
+                return 0
+            self._finalize("restarting")
+            return EX_RESTART
+
+    def _rebuild(self) -> None:
+        """Config change took effect: reconstruct the trainer under the
+        updated config and restore the boundary checkpoint — the same
+        bit-exact save/restore path a kill-and-resume takes, minus the
+        process exit."""
+        trainer = build_serve_trainer(self.cfg, self.membership)
+        if not self.is_leader:
+            trainer.checkpoint_writer = False
+        trainer.restore(self.ckpt_path)
+        if self.telemetry is not None:
+            from dopt.obs import attach
+
+            attach(trainer, self.telemetry,
+                   checkpoint_every=self.checkpoint_every or None)
+        self.trainer = trainer
+
+    def _finalize(self, status: str) -> None:
+        self.status = status
+        if self.is_leader:
+            # Consume any follower stop request on the way out — a
+            # stale flag would stop the next serve of this state dir
+            # at its first boundary.
+            try:
+                (self.state_dir / _RESTART_FLAG).unlink(missing_ok=True)
+            except OSError:
+                pass
+            if status == "drained":
+                from dopt.utils.metrics import atomic_write_text
+
+                report = (self.monitor.report().to_dict()
+                          if self.monitor is not None else None)
+                atomic_write_text(self.state_dir / _FINAL_FILE, json.dumps({
+                    "round": int(self.trainer.round),
+                    "history": self.trainer.history.rows,
+                    "fault_ledger": self.trainer.history.faults,
+                    "restarts": self.restarts,
+                    "report": report,
+                }, indent=2))
+        if self.admin is not None:
+            self.admin.shutdown()
+            self.admin = None
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
+        self.ledger.close()
+        self._write_status()
+
+    # -- admin-facing helpers ------------------------------------------
+    def submit(self, command: dict[str, Any]) -> dict[str, Any]:
+        """Queue one command (validated); applied at a round boundary."""
+        return self.queue.submit(command)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Status for ``GET /admin/status``."""
+        trainer = self.trainer
+        return {
+            "status": self.status,
+            "round": int(getattr(trainer, "round", 0)),
+            "paused": self.paused,
+            "checkpoint_every": self.checkpoint_every,
+            "last_checkpoint_round": self._last_ckpt,
+            "restarts": self.restarts,
+            "pending_commands": [c.get("id") for c in self._pending],
+            "workers": getattr(trainer, "num_workers", None),
+            "engine": getattr(trainer, "engine_kind", None),
+            "max_rounds": self.max_rounds,
+            "num_processes": self.num_processes,
+        }
+
+    def membership_snapshot(self) -> dict[str, Any]:
+        import numpy as np
+
+        trainer = self.trainer
+        w = getattr(trainer, "num_workers", 0)
+        away = (self.membership.away_at(int(trainer.round), w)
+                if self.membership is not None and w
+                else np.zeros(0, bool))
+        return {"workers": int(w),
+                "present": [int(i) for i in np.nonzero(~away)[0]],
+                "away": [int(i) for i in np.nonzero(away)[0]],
+                "log": self.membership.to_json()
+                if self.membership is not None else []}
+
+    def config_snapshot(self) -> dict[str, Any]:
+        cfg = self.cfg
+        out: dict[str, Any] = {"checkpoint_every": self.checkpoint_every,
+                               "paused": self.paused}
+        if cfg.optim is not None:
+            out["optim.lr"] = cfg.optim.lr
+        if cfg.population is not None:
+            out["population.cohort"] = cfg.population.cohort
+        return out

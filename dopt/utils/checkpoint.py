@@ -45,15 +45,41 @@ except ImportError:  # pragma: no cover
 
 
 def _to_numpy(tree):
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Materialise a pytree on the host.  On a multi-process fleet
+    (``dopt serve`` on real ``jax.distributed`` process groups) the
+    worker-stacked state is sharded ACROSS processes — a bare
+    ``device_get`` of a non-fully-addressable array raises — so those
+    leaves ride a ``process_allgather`` instead.  The allgather is a
+    COLLECTIVE: every process of the fleet must reach the checkpoint
+    together (the serve barrier protocol guarantees it); followers then
+    pass ``write=False`` to ``save_checkpoint`` and only the leader
+    touches the filesystem.  Single-process arrays are always fully
+    addressable, so scripted runs take the exact pre-change path."""
+    def _np(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(
+                x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(_np, tree)
 
 
 def _write_state(dest: Path, arrays: dict[str, Any]) -> None:
-    """Materialise the arrays pytree under ``dest`` (orbax or npz)."""
-    if HAVE_ORBAX:
+    """Materialise the arrays pytree under ``dest`` (orbax or npz).
+
+    On a multi-process fleet the npz path is used even with orbax
+    installed: ``PyTreeCheckpointer.save`` runs its own cross-process
+    barrier, but serve fleets have exactly ONE writer (followers
+    already joined the allgather and skip the filesystem), so the
+    orbax barrier would wait forever for processes that were never
+    going to save.  The arrays are plain host numpy by this point —
+    npz loses nothing."""
+    if HAVE_ORBAX and jax.process_count() <= 1:
         ckpt = ocp.PyTreeCheckpointer()
         ckpt.save(dest / "state", arrays)
-    else:  # numpy fallback keeps the feature alive without orbax
+    else:  # numpy path: no orbax, or a single-writer multi-process fleet
         np.savez(dest / "state.npz", **_flatten_for_npz(arrays))
 
 
@@ -79,17 +105,24 @@ def _write_marker(dest: Path) -> None:
 
 
 def save_checkpoint(path: str | Path, *, arrays: dict[str, Any],
-                    meta: dict[str, Any]) -> Path:
+                    meta: dict[str, Any], write: bool = True) -> Path:
     """Save an arrays pytree (orbax) + JSON metadata, atomically.
 
     The previous checkpoint at ``path`` is never modified in place: the
     new one is built in ``<path>.tmp`` and swapped in via two renames
     (old → ``<path>.old``, tmp → ``path``).  A crash anywhere in between
     leaves either ``path`` or ``<path>.old`` as a complete checkpoint.
+
+    ``write=False`` (multi-process serve followers) still runs the
+    host materialisation — whose cross-process allgather is a
+    collective every process must join — but skips the filesystem
+    entirely: one fleet, one writer, no rename races.
     """
     path = Path(path).absolute()
-    path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {k: _to_numpy(v) for k, v in arrays.items() if v is not None}
+    if not write:
+        return path
+    path.parent.mkdir(parents=True, exist_ok=True)
 
     tmp = path.with_name(path.name + ".tmp")
     old = path.with_name(path.name + ".old")

@@ -137,12 +137,17 @@ def cocktail_rules():
 
 
 def check_ledger(history, rounds: int, workers: int) -> int:
-    """Schema + range invariants over every fault-ledger row."""
+    """Schema + range invariants over every fault-ledger row.  Shared
+    with the serve soak (scripts/serve_soak.py), whose ledgers carry
+    fleet-level rows — control-plane config/drain/pause applications
+    and population cohort audits use ``worker == -1``."""
     for row in history.faults:
         assert set(row) == {"round", "worker", "kind", "action"}, row
         assert row["kind"] in KINDS, row
         assert 0 <= row["round"] < rounds, row
-        assert 0 <= row["worker"] < workers, row
+        assert -1 <= row["worker"] < workers, row
+        if row["worker"] == -1:
+            assert row["kind"] in ("control", "cohort"), row
         assert isinstance(row["action"], str) and row["action"], row
     return len(history.faults)
 

@@ -11,10 +11,15 @@ coordination service and asserting every process converges to the SAME
 trajectory (the determinism the in-process tests pin, now across a real
 process boundary with gloo CPU collectives standing in for ICI/DCN).
 
-Parent mode (default): picks a free port, spawns N children of this
-script, collects their output, and checks they all report the same
-final metrics.  Child mode (``--process-id I``) initialises
-``jax.distributed`` with explicit coordinator args and runs the round.
+Parent mode (default): spawns N children of this script sharing a
+coordinator HANDOFF file, collects their output, and checks they all
+report the same final metrics.  Child mode (``--process-id I``)
+self-organises the coordinator: child 0 binds a port-0 ephemeral port
+in its own process and publishes ``host:port`` through the handoff
+file (atomic rename), the others wait on it — no parent-probed fixed
+port, so the bind race window shrinks from the whole child-interpreter
+startup to microseconds inside one process
+(``dopt.parallel.multihost.coordinator_handoff``).
 
 Usage:
     python scripts/multiprocess_demo.py                # 2 procs × 4 devices
@@ -25,7 +30,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -35,29 +39,19 @@ OK_MARK = "MULTIPROC-ROUND-OK"
 
 
 def child_main(args) -> int:
-    # Platform + virtual-device setup must precede backend init: the
-    # env flag carries the device count, the config update out-ranks
-    # the axon sitecustomize's platform pin (same dance as
-    # __graft_entry__.dryrun_multichip).
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count="
-            f"{args.devices_per_proc}")
+    # Platform + virtual-device setup must precede backend init; the
+    # whole dance (flag replace, gloo pin, handoff rendezvous,
+    # jax.distributed init, topology asserts) is the shared
+    # bootstrap_child_backend — ONE implementation for this demo and
+    # the dopt.serve fleet children.
+    sys.path.insert(0, str(REPO))
+    from dopt.parallel.multihost import HOST_AXIS, bootstrap_child_backend
 
+    bootstrap_child_backend(args.handoff, args.process_id,
+                            args.num_processes, args.devices_per_proc)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    sys.path.insert(0, str(REPO))
-    from dopt.parallel.multihost import HOST_AXIS, initialize_distributed
-
-    ok = initialize_distributed(f"127.0.0.1:{args.port}",
-                                args.num_processes, args.process_id)
-    assert ok, "initialize_distributed returned False with explicit args"
-    assert jax.process_count() == args.num_processes
     assert jax.device_count() == args.num_processes * args.devices_per_proc
-    assert jax.local_device_count() == args.devices_per_proc
 
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
                              ModelConfig, OptimizerConfig)
@@ -88,16 +82,18 @@ def child_main(args) -> int:
 
 
 def parent_main(args) -> int:
-    # The free-port probe below is inherently TOCTOU (the socket closes
-    # before the child coordinator binds) — retry the whole spawn with a
-    # fresh port if the coordinator loses the race.
+    # Child 0 picks its own ephemeral port and hands it off through a
+    # file, so the historical parent-probe TOCTOU is gone; the retry
+    # loop stays for the one remaining non-dopt flake — gloo's tcp
+    # transport interleaving two collectives' messages under host load.
     diag = ""
     for attempt in range(3):
         rc, diag = _parent_attempt(args)
-        if rc != 3:  # 3 = retryable (port race / gloo transport race)
+        if rc != 3:  # 3 = retryable (residual bind race / gloo transport)
             return rc
         print(f"retryable launch failure (attempt {attempt + 1}/3), "
-              "respawning with a fresh coordinator port", file=sys.stderr)
+              "respawning with a fresh coordinator handoff",
+              file=sys.stderr)
     # Out of retries: surface the last attempt's child output so a
     # non-retryable failure that happened to match the heuristics is
     # still diagnosable from the logs.
@@ -108,27 +104,19 @@ def parent_main(args) -> int:
 
 
 def _parent_attempt(args) -> tuple[int, str]:
-    with socket.socket() as s:  # free port for the coordinator
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    import tempfile
 
-    import re
-
-    env = dict(os.environ)
-    # Replace (not append) any inherited device-count flag — the dryrun
-    # driver exports its own N and the last-one-wins behaviour is not
-    # contractual.
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", ""))
-    env["XLA_FLAGS"] = (f"{flags} --xla_force_host_platform_device_count="
-                        f"{args.devices_per_proc}")
+    handoff = os.path.join(tempfile.mkdtemp(prefix="dopt-mpdemo-"),
+                           "coordinator.json")
+    # No env surgery here: each child's bootstrap_child_backend
+    # REPLACES any inherited device-count flag itself.
     procs = [
         subprocess.Popen(
             [sys.executable, __file__, "--process-id", str(i),
              "--num-processes", str(args.num_processes),
              "--devices-per-proc", str(args.devices_per_proc),
-             "--port", str(port), "--rounds", str(args.rounds)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+             "--handoff", handoff, "--rounds", str(args.rounds)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
         for i in range(args.num_processes)
     ]
@@ -188,7 +176,8 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=420.0)
     ap.add_argument("--process-id", type=int, default=None,
                     help="(internal) run as child with this process id")
-    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--handoff", default=None,
+                    help="(internal) coordinator handoff file")
     args = ap.parse_args(argv)
     if args.process_id is not None:
         return child_main(args)

@@ -1,0 +1,292 @@
+"""Serve soak: the resident trainer, end-to-end, with invariants.
+
+The ``dopt serve`` acceptance harness — a scripted single-host
+resident run (real daemon subprocesses, real signals) that survives
+
+* a live **membership change** (leave + later rejoin through the
+  control plane → the churn/shard-reassignment machinery),
+* a live **config change** (an ``optim.lr`` step applied at a round
+  boundary via checkpoint → rebuild → restore),
+* a **SIGTERM rolling restart** (drain to the boundary → checkpoint →
+  re-exec in place → resume),
+
+and asserts the four things a resident trainer owes you:
+
+1. **Bit-exact elasticity** — the interrupted leg's History, fault
+   ledger (``control`` + ``churn`` rows included) and canonical
+   telemetry stream are IDENTICAL to an uninterrupted leg driven by
+   the same command schedule: zero non-ledgered divergence.
+2. **Ledgered control** — every applied command appears once in the
+   ledger and once as a deterministic ``control`` event, at the same
+   boundary round in both legs.
+3. **Stream integrity** — both metrics streams pass
+   ``dopt.obs.check`` (schema + gapless duplicate-free rounds across
+   the restart's segment headers).
+4. **Zero false positives** — the STOCK rule set raises no alert on
+   either leg, and the daemon's own in-process monitor (stock set +
+   the escalated drop-rate rule) reports healthy.
+
+    python scripts/serve_soak.py --rounds 48 --min-seconds 60
+    python scripts/serve_soak.py --engine federated --rounds 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dopt.serve.control import CommandQueue, make_command  # noqa: E402
+
+# Reuse the chaos soak's ledger-invariant checker (the serve ledger
+# adds fleet-level control rows, which it now accepts).
+from scripts.chaos_soak import check_ledger  # noqa: E402
+
+
+def serve_args(engine: str, rounds: int, seed: int,
+               checkpoint_every: int) -> list[str]:
+    """The CLI argv for one soak leg (tiny synthetic workload — the
+    soak exercises the runtime, not the model)."""
+    preset = "baseline1" if engine == "gossip" else "baseline3"
+    args = ["--preset", preset, "--num-users", "8",
+            "--max-rounds", str(rounds),
+            "--checkpoint-every", str(checkpoint_every),
+            "--set", "seed=%d" % seed,
+            "--set", "data.dataset=synthetic",
+            "--set", "data.synthetic_train_size=256",
+            "--set", "data.synthetic_test_size=64",
+            "--set", "model.model=mlp",
+            "--set", "model.faithful=false"]
+    if engine == "gossip":
+        args += ["--set", "gossip.local_ep=1", "--set", "gossip.local_bs=32"]
+    else:
+        args += ["--set", "federated.local_ep=1",
+                 "--set", "federated.local_bs=32"]
+    return args
+
+
+def seed_commands(state_dir: Path, rounds: int) -> dict[str, int]:
+    """The scripted command schedule, pinned to round boundaries so
+    both legs apply identically: leave at ~N/4, lr step at ~N/2,
+    rejoin at ~5N/8."""
+    marks = {"leave": max(rounds // 4, 1),
+             "lr": max(rounds // 2, 2),
+             "join": max(5 * rounds // 8, 3)}
+    q = CommandQueue(state_dir / "commands.jsonl")
+    q.submit(make_command("membership", worker=3, action="leave",
+                          at_round=marks["leave"], id="soak-leave"))
+    q.submit(make_command("config", key="optim.lr", value=0.05,
+                          at_round=marks["lr"], id="soak-lr"))
+    q.submit(make_command("membership", worker=3, action="join",
+                          at_round=marks["join"], id="soak-join"))
+    return marks
+
+
+def run_leg(name: str, state_dir: Path, argv: list[str], *,
+            on_term: str, kill_at: int | None = None,
+            timeout_s: float = 900.0) -> dict:
+    """Run one daemon subprocess to drain; with ``kill_at``, SIGTERM it
+    once the status file reports that round (the daemon drains to the
+    boundary, checkpoints, re-execs IN PLACE — same pid — and resumes
+    to the configured max)."""
+    state_dir.mkdir(parents=True, exist_ok=True)
+    cmd = [sys.executable, "-m", "dopt.serve", *argv,
+           "--state-dir", str(state_dir), "--on-term", on_term]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO)
+    status_path = state_dir / "serve.json"
+    killed = False
+    while True:
+        try:
+            rc = proc.wait(timeout=0.5)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        if time.time() - t0 > timeout_s:
+            proc.kill()
+            raise AssertionError(f"[{name}] leg timed out after "
+                                 f"{timeout_s:.0f}s")
+        if kill_at is not None and not killed and status_path.exists():
+            try:
+                st = json.loads(status_path.read_text())
+            except ValueError:
+                continue
+            if st.get("status") == "serving" and st.get("round", 0) \
+                    >= kill_at:
+                print(f"[{name}] SIGTERM at round {st['round']} "
+                      f"(pid {proc.pid}) -> rolling restart", flush=True)
+                os.kill(proc.pid, signal.SIGTERM)
+                killed = True
+    elapsed = time.time() - t0
+    assert rc == 0, f"[{name}] daemon exited rc={rc}"
+    if kill_at is not None:
+        assert killed, (f"[{name}] never reached round {kill_at} to "
+                        "deliver the SIGTERM")
+    final = json.loads((state_dir / "final.json").read_text())
+    if kill_at is not None:
+        assert final.get("restarts", 0) >= 1, \
+            f"[{name}] daemon drained without surviving a restart"
+    print(f"[{name}] drained at round {final['round']} in {elapsed:.1f}s "
+          f"(restarts={final.get('restarts', 0)})", flush=True)
+    final["_elapsed_s"] = elapsed
+    return final
+
+
+def check_streams(path_a: Path, path_b: Path, rounds: int) -> None:
+    from dopt.obs import HealthMonitor, JsonlSink, canonical, check_stream
+
+    ev_a = JsonlSink.read(path_a)
+    ev_b = JsonlSink.read(path_b)
+    sa, sb = check_stream(ev_a), check_stream(ev_b)
+    assert sa["rounds"] == sb["rounds"] == rounds, (sa, sb)
+    assert sb["segments"] >= sa["segments"] + 1, \
+        "restarted leg should carry at least one extra segment header"
+    ca, cb = canonical(ev_a), canonical(ev_b)
+    assert ca == cb, "canonical streams diverged between legs"
+    n_ctl = sum(1 for e in ca if e["kind"] == "control")
+    assert n_ctl == 3, f"expected 3 applied control events, saw {n_ctl}"
+    print(f"[streams] canonical equality ok: {sa['events']} vs "
+          f"{sb['events']} events, {n_ctl} control events each", flush=True)
+    # Zero false positives under the STOCK rule set, on both legs.
+    for name, evs in (("uninterrupted", ev_a), ("restarted", ev_b)):
+        mon = HealthMonitor()
+        mon.feed(evs)
+        rep = mon.report()
+        assert rep.alerts == 0 and rep.verdict == "healthy", \
+            (f"false-positive gate: {name} leg raised {rep.alerts} "
+             f"alerts: {mon.canonical_alerts()}")
+    print("[streams] zero stock-rule alerts on both legs", flush=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--engine", choices=("gossip", "federated"),
+                    default="gossip")
+    ap.add_argument("--checkpoint-every", type=int, default=8)
+    ap.add_argument("--min-seconds", type=float, default=0.0,
+                    help="assert the restarted leg stayed resident at "
+                         "least this long (the ROADMAP's >=60s soak bar)")
+    ap.add_argument("--state-root", default=None,
+                    help="scratch root (default: a temp dir)")
+    ap.add_argument("--report-out", default=None, metavar="PATH",
+                    help="write both legs' final reports as one JSON "
+                         "artifact here")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    # Resolved: the daemon subprocess runs with cwd=REPO, so a relative
+    # --state-root would otherwise name a different directory for the
+    # harness and the daemon.
+    root = Path(args.state_root
+                or tempfile.mkdtemp(prefix="dopt-soak-")).resolve()
+    rounds = args.rounds
+    attempt = 0
+    dir_a = root / "uninterrupted"
+    while True:
+        base = serve_args(args.engine, rounds, args.seed,
+                          args.checkpoint_every)
+        kill_at = max(3 * rounds // 8, 2)
+        if dir_a.exists():
+            import shutil
+
+            shutil.rmtree(dir_a)
+        marks_a = seed_commands(dir_a, rounds)
+        print(f"[soak] engine={args.engine} rounds={rounds} "
+              f"commands at {marks_a}, SIGTERM at >= {kill_at}", flush=True)
+        final_a = run_leg("uninterrupted", dir_a, base, on_term="drain")
+        # Self-calibration: round throughput varies 10x across CI
+        # hardware, and the bar is RESIDENT SECONDS, not rounds —
+        # rescale and redo the reference leg until it clears the bar
+        # with margin (the restarted leg only ever runs longer: it
+        # pays the re-exec warmup on top).
+        if args.min_seconds <= 0 \
+                or final_a["_elapsed_s"] >= args.min_seconds * 1.1:
+            break
+        scale = max(2, int(args.min_seconds * 1.3
+                           // max(final_a["_elapsed_s"], 1.0)) + 1)
+        rounds *= scale
+        attempt += 1
+        assert attempt <= 3, "soak calibration did not converge"
+        print(f"[soak] {final_a['_elapsed_s']:.1f}s < "
+              f"{args.min_seconds:.0f}s bar: rescaling to {rounds} "
+              "rounds", flush=True)
+
+    dir_b = root / "restarted"
+    if dir_b.exists():
+        # A persistent --state-root may hold a previous invocation's
+        # leg: resuming its drained state would end immediately and
+        # fail the comparison with a misleading message.
+        import shutil
+
+        shutil.rmtree(dir_b)
+    marks_b = seed_commands(dir_b, rounds)
+    assert marks_a == marks_b
+    final_b = run_leg("restarted", dir_b, base, on_term="restart",
+                      kill_at=kill_at)
+
+    assert final_b["history"] == final_a["history"], \
+        "History diverged between uninterrupted and restarted legs"
+    assert final_b["fault_ledger"] == final_a["fault_ledger"], \
+        "fault ledger diverged between uninterrupted and restarted legs"
+    rows = final_a["fault_ledger"]
+    check_ledger_rows = [r for r in rows]
+
+    class _H:  # check_ledger wants a History-shaped object
+        faults = check_ledger_rows
+
+    n = check_ledger(_H, rounds, 8)
+    kinds = sorted({r["kind"] for r in rows})
+    assert "control" in kinds and "churn" in kinds, kinds
+    print(f"[ledger] {n} rows identical across legs, kinds {kinds}",
+          flush=True)
+
+    check_streams(dir_a / "metrics.jsonl", dir_b / "metrics.jsonl",
+                  rounds)
+
+    for name, final in (("uninterrupted", final_a), ("restarted", final_b)):
+        rep = final.get("report") or {}
+        assert rep.get("verdict") == "healthy", \
+            f"{name} leg's in-process monitor: {rep}"
+    print("[monitor] in-process verdicts healthy on both legs", flush=True)
+
+    if args.min_seconds > 0:
+        assert final_b["_elapsed_s"] >= args.min_seconds, \
+            (f"restarted leg stayed resident only "
+             f"{final_b['_elapsed_s']:.1f}s < {args.min_seconds:.0f}s — "
+             "raise --rounds")
+
+    if args.report_out:
+        from dopt.utils.metrics import atomic_write_text
+
+        atomic_write_text(args.report_out, json.dumps({
+            "engine": args.engine, "rounds": rounds,
+            "commands": marks_a, "kill_at": kill_at,
+            "uninterrupted": {k: v for k, v in final_a.items()
+                              if k not in ("history", "fault_ledger")},
+            "restarted": {k: v for k, v in final_b.items()
+                          if k not in ("history", "fault_ledger")},
+        }, indent=2))
+        print(f"wrote soak report to {args.report_out}", flush=True)
+
+    print("serve soak passed: live membership + config change + SIGTERM "
+          "rolling restart with bit-exact resume, zero non-ledgered "
+          "divergence, zero false-positive alerts", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

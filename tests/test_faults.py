@@ -189,7 +189,7 @@ def test_parse_fault_spec():
         parse_fault_spec("crash=lots")
     assert set(KINDS) == {"crash", "straggler", "partition", "overselect",
                           "corrupt", "quarantine", "msg_drop", "msg_delay",
-                          "churn", "staleness", "cohort"}
+                          "churn", "staleness", "cohort", "control"}
     # the lossy-link / elastic-membership fields parse like any other
     cfg2 = parse_fault_spec(
         "msg_drop=0.1,msg_delay=0.2,msg_delay_max=3,churn=0.05,churn_span=2")

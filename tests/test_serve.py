@@ -1,0 +1,574 @@
+"""dopt.serve — the resident elastic trainer and its control plane.
+
+Covers the control-plane command semantics (apply-at-round-boundary,
+whitelist rejection, ledgered ``control`` events), the serve loop's
+drain/checkpoint/resume bit-identity (SIGTERM-equivalent restart vs an
+uninterrupted run of the same command schedule), in-process monitor
+parity vs file tailing, the checkpoint_cadence rule's header-sourced
+expectation, and the ``dopt.obs.serve`` port-0/state-file/SIGTERM
+satellite.  The multi-process rolling-restart leg (real
+``jax.distributed`` + gloo + a real SIGTERM) is marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                         ModelConfig, OptimizerConfig)
+from dopt.serve import (CONFIG_WHITELIST, CommandQueue, ControlLedger,
+                        EX_RESTART, ServeDaemon, build_serve_trainer,
+                        make_command, validate_command)
+from dopt.serve.control import (apply_config_change, control_ledger_row,
+                                replay_effects)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def tiny_gossip_cfg(seed: int = 5, rounds: int = 4) -> ExperimentConfig:
+    return ExperimentConfig(
+        name="serve-test", seed=seed,
+        data=DataConfig(dataset="synthetic", num_users=8, iid=True,
+                        synthetic_train_size=256, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=rounds, local_ep=1,
+                            local_bs=32))
+
+
+# ---------------------------------------------------------------- schema
+
+def test_command_schema_accept_reject():
+    ok = make_command("config", key="optim.lr", value=0.05, at_round=3,
+                      id="lr")
+    assert ok["v"] == 1 and ok["cmd"] == "config"
+    validate_command(make_command("membership", worker=3, action="leave"))
+    validate_command(make_command("drain", restart=True))
+    validate_command(make_command("checkpoint"))
+    with pytest.raises(ValueError, match="unknown command"):
+        validate_command({"v": 1, "cmd": "reboot"})
+    with pytest.raises(ValueError, match="version"):
+        validate_command({"v": 2, "cmd": "drain"})
+    with pytest.raises(ValueError, match="not whitelisted"):
+        make_command("config", key="gossip.topology", value=1)
+    with pytest.raises(ValueError, match="integer"):
+        make_command("config", key="population.cohort", value=3.5)
+    with pytest.raises(ValueError, match="lr must be > 0"):
+        make_command("config", key="optim.lr", value=0.0)
+    with pytest.raises(ValueError, match="worker"):
+        make_command("membership", worker=-1, action="leave")
+    with pytest.raises(ValueError, match="action"):
+        make_command("membership", worker=1, action="evict")
+    with pytest.raises(ValueError, match="at_round"):
+        make_command("checkpoint", at_round=-2)
+    assert set(CONFIG_WHITELIST) == {"optim.lr", "population.cohort",
+                                     "checkpoint_every"}
+
+
+def test_command_queue_incremental(tmp_path):
+    q = CommandQueue(tmp_path / "commands.jsonl")
+    q.submit(make_command("membership", worker=1, action="leave", id="a"))
+    q.submit(make_command("checkpoint"))
+    cmds, rejects = q.poll()
+    assert [c["id"] for c in cmds] == ["a", "q2"] and not rejects
+    assert q.poll() == ([], [])   # nothing new
+    # External writers can append raw lines; malformed ones become
+    # reject records instead of desynchronizing the tail.
+    with open(tmp_path / "commands.jsonl", "a") as f:
+        f.write("this is not json\n")
+        f.write(json.dumps({"v": 1, "cmd": "config", "key": "seed",
+                            "value": 1}) + "\n")
+        f.write(json.dumps(make_command("drain")) + "\n")
+    cmds, rejects = q.poll()
+    assert [c["cmd"] for c in cmds] == ["drain"]
+    assert len(rejects) == 2
+    assert "not JSON" in rejects[0]["reason"]
+    assert "whitelisted" in rejects[1]["reason"]
+    # A fresh tail (daemon restart) re-derives the same queue ids.
+    q2 = CommandQueue(tmp_path / "commands.jsonl")
+    cmds2, rejects2 = q2.poll()
+    assert [c["id"] for c in cmds2] == ["a", "q2", "q5"]
+    assert len(rejects2) == 2
+
+
+def test_control_ledger_replay(tmp_path):
+    path = tmp_path / "applied.jsonl"
+    led = ControlLedger(path)
+    led.append({"v": 1, "id": "m1", "cmd": "membership", "worker": 2,
+                "action": "leave", "status": "applied", "round": 3})
+    led.append({"v": 1, "id": "lr", "cmd": "config", "key": "optim.lr",
+                "value": 0.05, "status": "applied", "round": 5})
+    led.append({"v": 1, "id": "bad", "cmd": None, "status": "rejected",
+                "round": 5, "reason": "nope"})
+    led.append({"v": 1, "id": "ce", "cmd": "config",
+                "key": "checkpoint_every", "value": 3,
+                "status": "applied", "round": 6})
+    # Superseding record for a re-applied command: last one wins.
+    led.append({"v": 1, "id": "m1", "cmd": "membership", "worker": 2,
+                "action": "leave", "status": "applied", "round": 4})
+    led.close()
+    records = ControlLedger.replay(path)
+    assert [r["id"] for r in records] == ["m1", "lr", "bad", "ce"]
+    assert records[0]["round"] == 4   # superseded
+    fx = replay_effects(records, up_to_round=5)
+    assert fx["membership"] == [(4, 2, False)]
+    assert fx["config"] == [(5, "optim.lr", 0.05)]
+    assert fx["checkpoint_every"] is None   # round 6 > checkpoint round
+    assert fx["processed"] == {"m1", "lr", "bad"}
+    fx_all = replay_effects(records, up_to_round=10)
+    assert fx_all["checkpoint_every"] == 3
+
+
+def test_torn_tails_healed_on_append(tmp_path):
+    """A hard-killed writer's newline-less partial line must never
+    swallow the next append: the queue terminates it (the torn line
+    becomes a reject, the new command its own line) and the ledger
+    skips it on replay instead of discarding everything after it."""
+    qp = tmp_path / "commands.jsonl"
+    qp.write_text('{"v": 1, "cmd": "checkpo')   # torn mid-write
+    q = CommandQueue(qp)
+    q.submit(make_command("drain", id="d1"))
+    cmds, rejects = q.poll()
+    assert [c["id"] for c in cmds] == ["d1"]
+    assert len(rejects) == 1 and "not JSON" in rejects[0]["reason"]
+
+    lp = tmp_path / "applied.jsonl"
+    led = ControlLedger(lp)
+    led.append({"v": 1, "id": "a", "cmd": "pause", "status": "applied",
+                "round": 1})
+    led.close()
+    with open(lp, "a") as f:
+        f.write('{"v": 1, "id": "torn", "cmd": "resu')   # torn mid-append
+    led2 = ControlLedger(lp)
+    led2.append({"v": 1, "id": "b", "cmd": "resume", "status": "applied",
+                 "round": 2})
+    led2.close()
+    assert [r["id"] for r in ControlLedger.replay(lp)] == ["a", "b"]
+
+
+def test_apply_config_change_whitelist():
+    cfg = tiny_gossip_cfg()
+    out = apply_config_change(cfg, "optim.lr", 0.025)
+    assert out.optim.lr == 0.025 and cfg.optim.lr == 0.1
+    with pytest.raises(ValueError, match="whitelisted"):
+        apply_config_change(cfg, "seed", 1)
+
+
+def test_control_ledger_row_shapes():
+    row = control_ledger_row(make_command("config", key="optim.lr",
+                                          value=0.05, id="x"), 7)
+    assert row == {"round": 7, "worker": -1, "kind": "control",
+                   "action": "applied_config_optim.lr=0.05"}
+    row = control_ledger_row(make_command("membership", worker=3,
+                                          action="join"), 9)
+    assert row["worker"] == 3 and row["action"] == "applied_membership_join"
+
+
+# ------------------------------------------------- membership plumbing
+
+def test_membership_log_ordering_and_flags():
+    from dopt.faults import FaultPlan, MembershipLog
+
+    log = MembershipLog()
+    log.add(2, 1, False)
+    with pytest.raises(ValueError, match="round order"):
+        log.add(1, 0, False)
+    with pytest.raises(ValueError, match="worker >= 0"):
+        log.add(3, -1, True)
+    plan = FaultPlan(4, None, membership=log)
+    assert plan.active and plan.has_churn and plan.affects_matrix
+    assert not plan.may_straggle and not plan.has_corrupt
+    assert list(np.nonzero(plan.away_for_round(2))[0]) == [1]
+    assert not plan.away_for_round(1).any()
+    # Default plans untouched: the scripted-run off-path guarantee.
+    bare = FaultPlan(4, None)
+    assert not bare.active and not bare.has_churn and bare.cfg is None
+
+
+def test_membership_population_rejected():
+    import dataclasses
+
+    from dopt.config import PopulationConfig
+    from dopt.engine import GossipTrainer
+    from dopt.faults import MembershipLog
+
+    cfg = tiny_gossip_cfg()
+    cfg = dataclasses.replace(cfg, population=PopulationConfig(
+        clients=8, cohort=8))
+    with pytest.raises(ValueError, match="does not compose"):
+        GossipTrainer(cfg, membership=MembershipLog())
+
+
+def test_build_serve_trainer_rejects_torch_and_seqlm():
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_gossip_cfg(), backend="torch")
+    with pytest.raises(ValueError, match="jax engines only"):
+        build_serve_trainer(cfg, None)
+
+
+# ------------------------------------------------------- the serve loop
+
+class _TermAt(ServeDaemon):
+    """SIGTERM-equivalent at an exact boundary (deterministic tests
+    can't rely on signal delivery timing)."""
+
+    def __init__(self, *a, term_at=None, **kw):
+        super().__init__(*a, **kw)
+        self._term_at = term_at
+
+    def boundary(self, trainer):
+        if self._term_at is not None and trainer.round == self._term_at:
+            self._term = True
+            self._term_signal = self.on_term
+        return super().boundary(trainer)
+
+
+def _seed_commands(state_dir: Path) -> None:
+    q = CommandQueue(Path(state_dir) / "commands.jsonl")
+    q.submit(make_command("membership", worker=3, action="leave",
+                          at_round=1, id="m1"))
+    q.submit(make_command("config", key="optim.lr", value=0.05,
+                          at_round=2, id="lr1"))
+    q.submit(make_command("membership", worker=3, action="join",
+                          at_round=4, id="m2"))
+    q.submit(make_command("checkpoint", at_round=3, id="ck"))
+
+
+def test_serve_boundaries_and_restart_bit_identity(tmp_path):
+    """The acceptance core, in-process: a served run applies commands
+    at their pinned boundaries (ledgered control rows + churn rows +
+    deterministic control events), and a SIGTERM-equivalent restart
+    mid-run resumes BIT-EXACTLY — History, fault ledger and canonical
+    telemetry stream identical to the uninterrupted run."""
+    from dopt.obs import HealthMonitor, JsonlSink, canonical, check_stream
+
+    rounds = 6
+
+    # Leg A: uninterrupted.
+    dir_a = tmp_path / "a"
+    _seed_commands(dir_a)
+    da = ServeDaemon(tiny_gossip_cfg(), dir_a, checkpoint_every=2,
+                     max_rounds=rounds, admin_port=None).start()
+    assert da.serve() == 0
+    hist = da.trainer.history
+    ctl = [r for r in hist.faults if r["kind"] == "control"]
+    assert [(r["round"], r["action"]) for r in ctl] == [
+        (1, "applied_membership_leave"),
+        (2, "applied_config_optim.lr=0.05"),
+        (3, "applied_checkpoint"),
+        (4, "applied_membership_join"),
+    ]
+    churn = [(r["round"], r["action"]) for r in hist.faults
+             if r["kind"] == "churn"]
+    assert (1, "left") in churn and (4, "rejoined") in churn
+    assert any("shard_adopted" in a for _, a in churn)
+    assert da.trainer.cfg.optim.lr == 0.05   # rebuild took effect
+    ev_a = JsonlSink.read(dir_a / "metrics.jsonl")
+    summary = check_stream(ev_a)
+    assert summary["rounds"] == rounds
+    assert summary["kinds"]["control"] == 4
+    # final.json is the drain artifact the soak harness consumes.
+    final = json.loads((dir_a / "final.json").read_text())
+    assert final["round"] == rounds and final["history"] == hist.rows
+    assert final["report"]["verdict"] == "healthy"
+
+    # Leg B: restart at boundary 3 (post-rebuild), then resume.
+    dir_b = tmp_path / "b"
+    _seed_commands(dir_b)
+    db1 = _TermAt(tiny_gossip_cfg(), dir_b, checkpoint_every=2,
+                  max_rounds=rounds, admin_port=None, term_at=3).start()
+    assert db1.serve() == EX_RESTART
+    db2 = ServeDaemon(tiny_gossip_cfg(), dir_b, checkpoint_every=2,
+                      max_rounds=rounds, admin_port=None).start()
+    assert db2._resumed and db2.trainer.round == 3
+    assert db2.trainer.cfg.optim.lr == 0.05   # replayed from the ledger
+    assert db2.serve() == 0
+    assert db2.restarts == 1
+
+    assert db2.trainer.history.rows == hist.rows
+    assert db2.trainer.history.faults == hist.faults
+    ev_b = JsonlSink.read(dir_b / "metrics.jsonl")
+    check_stream(ev_b)
+    assert canonical(ev_b) == canonical(ev_a)
+
+    # Zero false positives (stock rules) and alert parity between the
+    # two legs' streams.
+    ma, mb = HealthMonitor(), HealthMonitor()
+    ma.feed(ev_a)
+    mb.feed(ev_b)
+    assert ma.report().alerts == 0 and ma.report().verdict == "healthy"
+    assert ma.canonical_alerts() == mb.canonical_alerts()
+
+
+def test_serve_rejects_unwhitelisted_and_out_of_range(tmp_path):
+    """Rejected commands are recorded in the applied ledger but never
+    ledgered as control rows or events."""
+    from dopt.obs import JsonlSink
+
+    state = tmp_path / "s"
+    state.mkdir()
+    with open(state / "commands.jsonl", "w") as f:
+        f.write(json.dumps({"v": 1, "cmd": "config", "key": "seed",
+                            "value": 9, "id": "bad-key"}) + "\n")
+        f.write(json.dumps(make_command("membership", worker=99,
+                                        action="leave",
+                                        id="bad-worker")) + "\n")
+    d = ServeDaemon(tiny_gossip_cfg(), state, checkpoint_every=0,
+                    max_rounds=2, admin_port=None).start()
+    assert d.serve() == 0
+    assert not any(r["kind"] == "control"
+                   for r in d.trainer.history.faults)
+    records = {r["id"]: r for r in ControlLedger.replay(
+        state / "applied.jsonl")}
+    assert records["bad-key"]["status"] == "rejected"
+    assert records["bad-worker"]["status"] == "rejected"
+    assert "lane fleet" in records["bad-worker"]["reason"]
+    evs = JsonlSink.read(state / "metrics.jsonl")
+    assert not any(e["kind"] == "control" for e in evs)
+
+
+def test_auto_pause_on_drop_rate_critical(tmp_path):
+    """A drop_rate-critical alert auto-pauses admission: the daemon
+    self-applies a ledgered pause command and join commands are
+    rejected until a resume."""
+    from dopt.obs import HealthMonitor
+
+    d = ServeDaemon(tiny_gossip_cfg(), tmp_path, admin_port=None)
+    d.monitor = HealthMonitor([])
+    d.monitor.alerts = [{"kind": "alert", "rule": "drop_rate_critical",
+                         "severity": "critical", "round": 2}]
+    trainer = SimpleNamespace(num_workers=8, round=3,
+                              history=SimpleNamespace(faults=[]),
+                              save=lambda path: None)
+    directive = d._decide(3, trainer)
+    assert [c["cmd"] for c in directive["apply"]] == ["pause"]
+    assert directive["auto"] == ["auto-pause-3"]
+    assert d._execute(directive, trainer) == "run"
+    assert d.paused
+    rec = ControlLedger.replay(tmp_path / "applied.jsonl")[0]
+    assert rec["auto"] is True and rec["status"] == "applied"
+    assert trainer.history.faults == [
+        {"round": 3, "worker": -1, "kind": "control",
+         "action": "applied_pause"}]
+    # While paused, a join is rejected at the boundary...
+    d.queue.submit(make_command("membership", worker=1, action="join",
+                                id="j1"))
+    directive = d._decide(4, trainer)
+    assert directive["apply"] == []
+    assert [r["id"] for r in directive["rejected"]] == ["j1"]
+    assert "paused" in directive["rejected"][0]["reason"]
+    d._execute(directive, trainer)
+    # ...and flows again after a resume.
+    d.queue.submit(make_command("resume", id="r1"))
+    d.queue.submit(make_command("membership", worker=1, action="join",
+                                id="j2"))
+    directive = d._decide(5, trainer)
+    assert [c["id"] for c in directive["apply"]] == ["r1", "j2"]
+
+
+def test_serve_rules_escalation_silent_by_default():
+    from dopt.serve import serve_rules
+
+    rules = serve_rules()
+    names = [r.name for r in rules]
+    assert "drop_rate_critical" in names and "drop_rate" in names
+    esc = next(r for r in rules if r.name == "drop_rate_critical")
+    assert esc.severity == "critical"
+
+
+# --------------------------------- checkpoint_cadence from the header
+
+def _hdr(round_=0, **kw):
+    from dopt.obs import make_event
+
+    return make_event("run", engine="gossip", name="t", round=round_, **kw)
+
+
+def _round(t):
+    from dopt.obs import make_event
+
+    return make_event("round", round=t, engine="gossip",
+                      metrics={"avg_train_loss": 1.0})
+
+
+def test_checkpoint_cadence_reads_run_header():
+    from dopt.obs import HealthMonitor, make_event
+
+    # Header declares every-2; no checkpoint events ever: overdue at
+    # round 4 (2 + slack 1 exceeded).
+    mon = HealthMonitor()
+    mon.feed([_hdr(checkpoint_every=2)] + [_round(t) for t in range(5)])
+    fired = [a["rule"] for a in mon.alerts]
+    assert fired == ["checkpoint_cadence"]
+    # Same stream, checkpoints on cadence: silent.
+    mon2 = HealthMonitor()
+    evs = [_hdr(checkpoint_every=2)]
+    for t in range(5):
+        evs.append(_round(t))
+        if t % 2 == 1:
+            evs.append(make_event("checkpoint", round=t))
+    mon2.feed(evs)
+    assert mon2.alerts == []
+    # No header field, no explicit every: the rule stays inactive.
+    mon3 = HealthMonitor()
+    mon3.feed([_hdr()] + [_round(t) for t in range(8)])
+    assert mon3.alerts == []
+
+
+def test_checkpoint_cadence_follows_control_event():
+    from dopt.obs import HealthMonitor, make_event
+
+    mon = HealthMonitor()
+    evs = [_hdr(checkpoint_every=10)]
+    evs += [_round(t) for t in range(3)]
+    # A live cadence change to every-1 makes round 6 overdue even
+    # though the header said 10.
+    evs.append(make_event("control", round=3, cmd="config",
+                          key="checkpoint_every", value=1, id="ce"))
+    evs += [_round(t) for t in range(3, 7)]
+    mon.feed(evs)
+    assert [a["rule"] for a in mon.alerts] == ["checkpoint_cadence"]
+    # Monitor state round-trips the context (restart-safe).
+    st = json.loads(json.dumps(mon.state()))
+    mon2 = HealthMonitor(state=st)
+    assert mon2.ctx.checkpoint_every == 1
+
+
+def test_attach_stamps_checkpoint_every(tmp_path):
+    from dopt.obs import MemorySink, Telemetry, attach
+
+    tele = Telemetry([MemorySink()])
+    trainer = SimpleNamespace(round=0, num_workers=4,
+                              timers=SimpleNamespace(tracer=None),
+                              cfg=SimpleNamespace(name="x"),
+                              telemetry=None, engine_kind="gossip")
+    attach(trainer, tele, checkpoint_every=4)
+    hdr = tele.sinks[0].events[0]
+    assert hdr["kind"] == "run" and hdr["checkpoint_every"] == 4
+    tele2 = Telemetry([MemorySink()])
+    attach(trainer, tele2)
+    assert "checkpoint_every" not in tele2.sinks[0].events[0]
+
+
+# ------------------------------------------- obs.serve CLI satellite
+
+def test_obs_serve_port0_statefile_sigterm(tmp_path):
+    """`python -m dopt.obs.serve --port 0`: the ephemeral port is
+    announced on stdout and in --state-file, the endpoint serves, and
+    SIGTERM shuts down gracefully (exit 0, state file removed)."""
+    metrics = tmp_path / "metrics.jsonl"
+    with open(metrics, "w") as f:
+        for ev in [_hdr(), _round(0), _round(1)]:
+            f.write(json.dumps(ev) + "\n")
+    state = tmp_path / "endpoint.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dopt.obs.serve", str(metrics),
+         "--port", "0", "--state-file", str(state)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO)
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["port"] > 0 and info["pid"] == proc.pid
+        deadline = time.time() + 10
+        while not state.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert json.loads(state.read_text())["port"] == info["port"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{info['port']}/healthz",
+                timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["rounds"] == 2
+        os.kill(proc.pid, signal.SIGTERM)
+        rc = proc.wait(timeout=15)
+        assert rc == 0
+        assert not state.exists()
+    finally:
+        proc.stdout.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# ------------------------------------------------- multi-process legs
+
+@pytest.mark.slow
+def test_multiprocess_serve_rolling_restart(tmp_path):
+    """REAL fleet: 2 jax.distributed processes (gloo), drain at 8
+    rounds after surviving a live config-change rebuild (the
+    leader-directive barrier revisits a boundary — the sequence-keyed
+    directive path) and a SIGTERM-driven rolling restart of a follower
+    — the fleet quiesces at the boundary, checkpoints once, respawns
+    as the next generation, and resumes to a healthy drain."""
+    state = tmp_path / "fleet"
+    CommandQueue(state / "commands.jsonl").submit(
+        make_command("config", key="optim.lr", value=0.05, at_round=2,
+                     id="fleet-lr"))
+    cmd = [sys.executable, "-m", "dopt.serve", "--preset", "baseline1",
+           "--state-dir", str(state),
+           "--set", "data.dataset=synthetic",
+           "--set", "data.synthetic_train_size=256",
+           "--set", "data.synthetic_test_size=64",
+           "--set", "model.model=mlp", "--set", "model.faithful=false",
+           "--set", "gossip.local_ep=1", "--set", "gossip.local_bs=32",
+           "--num-users", "8", "--max-rounds", "40",
+           "--checkpoint-every", "5", "--no-admin",
+           "--num-processes", "2", "--devices-per-proc", "2"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    sup = subprocess.Popen(cmd, env=env, cwd=REPO,
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 420
+        killed = False
+        while sup.poll() is None:
+            assert time.time() < deadline, "fleet timed out"
+            time.sleep(0.1)
+            status = state / "serve.json"
+            if killed or not status.exists():
+                continue
+            try:
+                st = json.loads(status.read_text())
+            except ValueError:
+                continue
+            if st.get("status") == "serving" \
+                    and 1 <= st.get("round", 0) <= 30:
+                # No leading dashes in the pattern: pgrep would parse
+                # them as its own options.
+                out = subprocess.run(
+                    ["pgrep", "-f",
+                     f"state-dir {state}.*process-id 1"],
+                    capture_output=True, text=True)
+                pids = [int(p) for p in out.stdout.split()]
+                if pids:
+                    os.kill(pids[0], signal.SIGTERM)
+                    killed = True
+        log = sup.communicate()[0]
+        assert sup.returncode == 0, \
+            f"supervisor rc={sup.returncode}\n--- output ---\n{log[-4000:]}"
+        assert killed, "never caught the fleet inside the SIGTERM window"
+        final = json.loads((state / "final.json").read_text())
+        assert final["round"] == 40
+        assert final["report"]["verdict"] == "healthy"
+        assert any(r["kind"] == "control"
+                   and "optim.lr" in r["action"]
+                   for r in final["fault_ledger"])
+        assert final["restarts"] >= 1
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.wait()
