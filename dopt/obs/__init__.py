@@ -53,6 +53,8 @@ from typing import Any, Iterable, Mapping
 from dopt.obs.events import (DETERMINISTIC_KINDS, KINDS, SCHEMA_VERSION,
                              canonical, check_stream, make_event,
                              sanitize_metrics, validate_event)
+from dopt.obs.latency import (SLO_LATENCIES, LatencyHistogram,
+                              summarize_latency_events)
 from dopt.obs.monitor import HealthMonitor, HealthReport, JsonlTail
 from dopt.obs.rules import RULES, build_rules, default_rules
 from dopt.obs.sinks import JsonlSink, MemorySink, PrometheusSink, Sink
@@ -60,12 +62,30 @@ from dopt.obs.spans import SpanTracer
 
 __all__ = [
     "DETERMINISTIC_KINDS", "KINDS", "RULES", "SCHEMA_VERSION",
+    "SLO_LATENCIES", "FleetAggregator", "FleetMetricsServer",
     "HealthMonitor", "HealthReport", "JsonlSink", "JsonlTail",
-    "MemorySink", "PrometheusSink", "Sink", "SpanTracer", "Telemetry",
-    "attach", "build_rules", "canonical", "check_stream",
-    "consensus_distance", "default_rules", "make_event",
-    "sanitize_metrics", "validate_event",
+    "LatencyHistogram", "MemorySink", "PrometheusSink", "Sink",
+    "SpanTracer", "Telemetry", "attach", "build_rules", "canonical",
+    "check_stream", "consensus_distance", "default_rules",
+    "first_divergence", "make_event", "sanitize_metrics",
+    "summarize_latency_events", "validate_event",
 ]
+
+
+def __getattr__(name: str):
+    # The fleet aggregation layer and the stream differ are imported
+    # lazily: they are CLI-facing modules with their own http.server /
+    # argparse surface, and the hot telemetry path (engines importing
+    # dopt.obs per round bundle) should not pay for them.
+    if name in ("FleetAggregator", "FleetMetricsServer"):
+        from dopt.obs import aggregate
+
+        return getattr(aggregate, name)
+    if name == "first_divergence":
+        from dopt.obs.diff import first_divergence
+
+        return first_divergence
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class Telemetry:

@@ -102,9 +102,35 @@ def print_summary(path: str, inv: dict[str, Any]) -> None:
             f"{k}={v}" for k, v in sorted(inv["alerts"].items())))
 
 
+def fleet_stream_paths(state_dir: str) -> list[str]:
+    """Every metrics stream under a serve state dir (or a soak state
+    root): the leader's + follower streams named by
+    ``dopt.obs.aggregate.fleet_metric_paths`` (ONE definition of the
+    fleet's stream layout), applied to the dir itself and one
+    directory level down (a soak root holding per-leg state dirs)."""
+    from pathlib import Path
+
+    from dopt.obs.aggregate import fleet_metric_paths
+
+    root = Path(state_dir)
+    dirs = [root] + (sorted(d for d in root.iterdir() if d.is_dir())
+                     if root.is_dir() else [])
+    found: list[str] = []
+    for d in dirs:
+        for _, path in sorted(fleet_metric_paths(d).items()):
+            if path.exists():
+                found.append(str(path))
+    return found
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("paths", nargs="+", metavar="METRICS_JSONL")
+    ap.add_argument("paths", nargs="*", metavar="METRICS_JSONL")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="additionally check every metrics*.jsonl under "
+                         "this serve state dir (one level of "
+                         "subdirectories included) — one invocation "
+                         "validates a whole fleet's streams")
     ap.add_argument("--summary", action="store_true",
                     help="print a per-file inventory (per-kind counts, "
                          "round span per segment, gauge keys, alert "
@@ -113,9 +139,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="machine-readable report on stdout (the "
                          "dopt.analysis CLI convention)")
     args = ap.parse_args(argv)
+    paths = list(args.paths)
+    if args.state_dir is not None:
+        found = fleet_stream_paths(args.state_dir)
+        if not found and not paths:
+            print(f"{args.state_dir}: FAIL no metrics*.jsonl streams "
+                  "found", file=sys.stderr)
+            return 1
+        paths.extend(p for p in found if p not in paths)
+    if not paths:
+        ap.error("give METRICS_JSONL paths and/or --state-dir")
     rc = 0
     report: list[dict[str, Any]] = []
-    for path in args.paths:
+    for path in paths:
         try:
             events = JsonlSink.read(path)
             if not events:
@@ -141,7 +177,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.summary:
             print_summary(path, summarize(events))
     if args.json:
-        json.dump({"tool": "dopt.obs.check", "checked": len(args.paths),
+        json.dump({"tool": "dopt.obs.check", "checked": len(paths),
                    "files": report, "clean": rc == 0},
                   sys.stdout, indent=2, sort_keys=True)
         sys.stdout.write("\n")

@@ -67,6 +67,15 @@ a ``kind``, and a wall-clock ``ts``.  The kinds:
              compile, an upper bound).  NOT deterministic: the
              per-round and blocked paths trace different programs at
              different times; the retrace-storm rule consumes it.
+``latency``  one SLO latency observation (``dopt.obs.latency``):
+             ``name`` (boundary_tick | command_apply |
+             checkpoint_save | checkpoint_restore | alert_latency),
+             ``seconds``, the boundary ``round``.  NOT deterministic —
+             wall-clock durations, like ``resource``/``compile`` —
+             so a stream carrying them still compares canonically
+             equal across execution paths; ``PrometheusSink`` folds
+             them into fixed-bucket histograms and the monitor's
+             ``HealthReport`` summarizes p50/p95/p99.
 
 The v1 schema evolves additively: new kinds and new optional fields
 appear under the same ``v`` (consumers ignore unknown kinds/keys);
@@ -91,7 +100,8 @@ from typing import Any, Iterable
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "round", "gauge", "fault", "phase", "bench", "warning",
-         "alert", "checkpoint", "resource", "compile", "control")
+         "alert", "checkpoint", "resource", "compile", "control",
+         "latency")
 
 ALERT_SEVERITIES = ("warn", "critical")
 
@@ -298,6 +308,12 @@ def validate_event(ev: Any) -> dict[str, Any]:
         v = ev.get("seconds")
         if not _is_num(v) or not math.isfinite(v) or v < 0:
             _fail("compile event needs finite seconds >= 0", ev)
+    elif kind == "latency":
+        _req_int(ev, "round")
+        _req_str(ev, "name")
+        v = ev.get("seconds")
+        if not _is_num(v) or not math.isfinite(v) or v < 0:
+            _fail("latency event needs finite seconds >= 0", ev)
     return ev
 
 

@@ -33,6 +33,7 @@ from pathlib import Path
 from typing import Any, Iterable
 
 from dopt.obs.events import make_event, validate_event
+from dopt.obs.latency import LatencyHistogram
 from dopt.obs.rules import Rule, RunContext, default_rules
 from dopt.obs.sinks import Sink
 
@@ -109,6 +110,11 @@ class HealthReport:
     by_severity: dict[str, int]
     last_round: int | None
     engines: list[str]
+    # SLO latency summaries (p50/p95/p99 per latency name) folded from
+    # the stream's ``latency`` events plus the monitor's own measured
+    # alert latency — what the soak's SLO report and ``final.json``
+    # carry.  Empty when the stream carries no latency channel.
+    latency: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -143,6 +149,19 @@ class HealthMonitor(Sink):
         self._engines: list[str] = []
         self._by_rule: dict[str, int] = {}
         self._by_severity: dict[str, int] = {}
+        # SLO latency histograms: per-name fixed-bucket histograms fed
+        # from the stream's ``latency`` events, plus the monitor's own
+        # ``alert_latency`` measurement (triggering round bundle ts →
+        # alert emit ts, taken at fire time).  JSON-able, part of
+        # ``state()`` like the rule windows — a restarted monitor keeps
+        # accumulating instead of forgetting the run's tail latencies.
+        self.latency: dict[str, LatencyHistogram] = {}
+        # Wall-clock staleness meters: the ts of the newest event seen
+        # (any kind) and of the newest round event — /healthz reports
+        # "last event ts vs wall" so a stalled producer is
+        # distinguishable from a healthy idle one.
+        self.last_event_ts: float | None = None
+        self._last_round_ts: float | None = None
         self._telemetry = None
         self._tail: JsonlTail | None = None
         self._tail_offset = 0
@@ -160,8 +179,23 @@ class HealthMonitor(Sink):
         """Evaluate one event against every rule; returns the alert
         events fired (schema-stamped, already recorded)."""
         kind = ev.get("kind")
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            self.last_event_ts = (float(ts) if self.last_event_ts is None
+                                  else max(self.last_event_ts, float(ts)))
         if kind == "alert":
             return []   # never feed alerts back into the rules
+        if kind == "latency":
+            # The SLO latency channel: accumulate into the per-name
+            # histograms the HealthReport summarizes.  No rule reads
+            # the kind (wall-clock durations), so fall through is safe
+            # but pointless.
+            v = ev.get("seconds")
+            if isinstance(v, (int, float)) and v >= 0:
+                self.latency.setdefault(
+                    str(ev.get("name", "?")),
+                    LatencyHistogram()).observe(float(v))
+            return []
         if kind == "run":
             self.ctx.engine = ev.get("engine")
             if isinstance(ev.get("workers"), int):
@@ -197,6 +231,8 @@ class HealthMonitor(Sink):
         elif kind == "round":
             self.rounds_seen += 1
             self.ctx.round = int(ev["round"])
+            if isinstance(ts, (int, float)):
+                self._last_round_ts = float(ts)
         elif kind == "gauge":
             # Denominator gauges the engines emit for the
             # fleet-fraction rules.
@@ -208,14 +244,61 @@ class HealthMonitor(Sink):
             elif name == "participating_lanes":
                 self.ctx.participating = float(ev["value"])
         fired: list[dict[str, Any]] = []
+        extras: list[dict[str, Any]] = []
         for rule in self.rules:
             for payload in rule.update(ev, self.ctx):
-                fired.append(self._record(rule, payload))
-        if fired and self._telemetry is not None:
+                alert = self._record(rule, payload)
+                fired.append(alert)
+                lat = self._alert_latency(alert, ev)
+                if lat is not None:
+                    extras.append(lat)
+        if (fired or extras) and self._telemetry is not None:
             for s in self._telemetry.sinks:
                 if s is not self:
-                    s.emit_many(fired)
+                    s.emit_many(fired + extras)
         return fired
+
+    def _alert_latency(self, alert: dict[str, Any],
+                       trigger: dict[str, Any]) -> dict[str, Any] | None:
+        """Measure one alert's latency — the TRIGGERING event's ``ts``
+        (the gauge/round/fault of its bundle that tripped the rule; a
+        gauge-driven rule fires before the bundle's round event lands,
+        so the previous round event would overstate by a full round
+        interval) to the alert event's ``ts``, both stamped by the same
+        producer clock — into the ``alert_latency`` histogram, and
+        return the ``latency`` event to forward into the stream.  ONLY
+        measured when the monitor rides the live fan-out (``attach``):
+        a tail/replay-fed monitor (fleet endpoint, watch, an offline
+        soak gate) observes historical ``ts`` stamps, so "alert now
+        minus event then" would report poll cadence, not alert latency
+        — those consumers get the channel from the stream's own
+        embedded latency events instead (the ``latency``-kind branch
+        above)."""
+        if self._telemetry is None:
+            return None
+        ts = alert.get("ts")
+        anchor = trigger.get("ts")
+        if not isinstance(anchor, (int, float)):
+            anchor = self._last_round_ts
+        if anchor is None or not isinstance(ts, (int, float)):
+            return None
+        lat = max(0.0, float(ts) - float(anchor))
+        self.latency.setdefault("alert_latency",
+                                LatencyHistogram()).observe(lat)
+        return make_event("latency", round=int(alert.get("round", 0)),
+                          name="alert_latency", seconds=round(lat, 6))
+
+    def lag_seconds(self, now: float | None = None) -> float | None:
+        """Wall seconds since the newest event this monitor has seen —
+        the "is the producer stalled or just idle" meter /healthz
+        reports; None before any event."""
+        if self.last_event_ts is None:
+            return None
+        if now is None:
+            import time
+
+            now = time.time()  # dopt: allow-wallclock -- staleness meter vs the event ts stamps, reporting only
+        return max(0.0, float(now) - self.last_event_ts)
 
     def _record(self, rule: Rule, payload: dict[str, Any]) -> dict[str, Any]:
         ev = make_event(
@@ -285,6 +368,10 @@ class HealthMonitor(Sink):
                     "round": self.ctx.round},
             "rules": {r.name: json.loads(json.dumps(r.s))
                       for r in self.rules},
+            "latency": {name: h.state()
+                        for name, h in self.latency.items()},
+            "last_event_ts": self.last_event_ts,
+            "last_round_ts": self._last_round_ts,
         }
 
     def load_state(self, st: dict[str, Any]) -> None:
@@ -307,6 +394,10 @@ class HealthMonitor(Sink):
         for r in self.rules:
             if r.name in saved:
                 r.s = dict(saved[r.name])
+        self.latency = {name: LatencyHistogram.from_state(hs)
+                        for name, hs in st.get("latency", {}).items()}
+        self.last_event_ts = st.get("last_event_ts")
+        self._last_round_ts = st.get("last_round_ts")
 
     # -- results -------------------------------------------------------
     def canonical_alerts(self) -> list[dict[str, Any]]:
@@ -330,4 +421,6 @@ class HealthMonitor(Sink):
             by_rule=dict(self._by_rule),
             by_severity=dict(self._by_severity),
             last_round=self.ctx.round if self.ctx.round >= 0 else None,
-            engines=list(self._engines))
+            engines=list(self._engines),
+            latency={name: h.summary()
+                     for name, h in sorted(self.latency.items())})

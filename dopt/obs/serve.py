@@ -39,6 +39,25 @@ from dopt.obs.monitor import HealthMonitor, JsonlTail
 from dopt.obs.rules import Rule
 from dopt.obs.sinks import PrometheusSink
 
+# Backoff hint every dopt HTTP surface sends on 503: the endpoint is
+# critical or still attaching, not gone — poll again, don't hammer.
+RETRY_AFTER_S = 5
+
+
+def http_reply(handler: BaseHTTPRequestHandler, code: int, body: bytes,
+               ctype: str, *, retry_after_s: int = RETRY_AFTER_S) -> None:
+    """The ONE reply path of every dopt scrape/admin handler
+    (dopt.obs.serve, dopt.obs.aggregate, dopt.serve.admin): status,
+    Content-Type/-Length, and the ``Retry-After`` header on every 503
+    — a header tweak lands on all three surfaces at once."""
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    if code == 503:
+        handler.send_header("Retry-After", str(retry_after_s))
+    handler.end_headers()
+    handler.wfile.write(body)
+
 
 class MetricsServer:
     """Tail a metrics JSONL file and serve /metrics + /healthz.
@@ -81,6 +100,12 @@ class MetricsServer:
         report = self.monitor.report()
         body = report.to_dict()
         body["metrics_path"] = str(self.metrics_path)
+        # The monitor's own staleness: wall seconds since the newest
+        # event in the stream.  A healthy-but-idle producer and a
+        # stalled one report the same verdict; the lag tells them
+        # apart (null before the first event).
+        body["last_event_ts"] = self.monitor.last_event_ts
+        body["lag_seconds"] = self.monitor.lag_seconds()
         return (200 if report.ok else 503), json.dumps(body, indent=2)
 
     def _handler(self) -> type[BaseHTTPRequestHandler]:
@@ -103,11 +128,7 @@ class MetricsServer:
                     self._reply(404, b"not found\n", "text/plain")
 
             def _reply(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                http_reply(self, code, body, ctype)
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 pass  # scrapes every few seconds would flood stderr

@@ -231,6 +231,10 @@ class PrometheusSink(Sink):
         self._faults: dict[str, int] = {}
         self._alerts: dict[tuple[str, str], int] = {}
         self._compiles: dict[str, int] = {}
+        # latency name -> fixed-bucket histogram (dopt.obs.latency);
+        # rendered as one proper Prometheus *histogram* family with the
+        # latency name as a label.
+        self._latency: dict[str, Any] = {}
 
     def _set(self, name: str, help_: str, engine: str | None,
              value: float) -> None:
@@ -272,6 +276,15 @@ class PrometheusSink(Sink):
             c = event.get("count")
             self._compiles[fn] = self._compiles.get(fn, 0) + (
                 int(c) if isinstance(c, int) else 1)
+        elif kind == "latency":
+            v = event.get("seconds")
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v >= 0:
+                from dopt.obs.latency import LatencyHistogram
+
+                name = str(event.get("name", "?"))
+                self._latency.setdefault(
+                    name, LatencyHistogram()).observe(float(v))
 
     def render(self) -> str:
         lines = []
@@ -308,6 +321,14 @@ class PrometheusSink(Sink):
                 lines.append(
                     f'dopt_compiles_total{{fn="{_label_value(fn)}"}} '
                     f'{self._compiles[fn]}')
+        if self._latency:
+            lines.append("# HELP dopt_latency_seconds SLO latency "
+                         "observations (latency events), by name")
+            lines.append("# TYPE dopt_latency_seconds histogram")
+            for name in sorted(self._latency):
+                lines.extend(self._latency[name].exposition(
+                    "dopt_latency_seconds",
+                    f'name="{_label_value(name)}"'))
         return "\n".join(lines) + "\n"
 
     def write(self, path: str | Path | None = None) -> Path:

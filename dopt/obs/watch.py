@@ -12,14 +12,24 @@ streamed off the training host::
 
     python -m dopt.obs.watch metrics.jsonl            # live, 2s refresh
     python -m dopt.obs.watch metrics.jsonl --once     # one snapshot
+    python -m dopt.obs.watch --state-dir run/         # FLEET mode
+
+Fleet mode (``--state-dir``) tails every process's stream of a
+``dopt serve --num-processes N`` state dir through the
+``FleetAggregator``: one terminal view with per-process rounds/s and
+loss columns, the cross-process consistency verdict, the merged alert
+feed with process provenance, and the admin endpoint read from the
+daemon's ``serve.json``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any
 
 from dopt.obs.monitor import HealthMonitor, JsonlTail
@@ -179,9 +189,159 @@ class WatchState:
         return "\n".join(lines)
 
 
+class FleetWatchState:
+    """One screenful over a whole serve fleet's streams, built on the
+    ``FleetAggregator``: per-process round/rate/loss/lag rows, the
+    cross-process consistency verdict, and the merged alert feed with
+    process provenance."""
+
+    def __init__(self, state_dir: str, processes: int | None = None):
+        self.state_dir = Path(state_dir)
+        self._processes = processes
+        self.error: str | None = None
+        self.status: dict[str, Any] = {}   # serve.json, one read per tick
+        self._refresh_status()
+        self.agg = self._build()
+
+    def _build(self):
+        from dopt.obs.aggregate import FleetAggregator
+
+        return FleetAggregator(self.state_dir,
+                               num_processes=self._expected())
+
+    def _refresh_status(self) -> None:
+        """ONE status read per tick (serve.json, falling back to the
+        supervisor's fleet.json), shared by the expected-fleet-size
+        probe and the render header — the state dir may be remote."""
+        for name in ("serve.json", "fleet.json"):
+            try:
+                self.status = json.loads(
+                    (self.state_dir / name).read_text())
+                return
+            except (OSError, ValueError):
+                continue
+        self.status = {}
+
+    def _expected(self) -> int | None:
+        """Expected fleet size: the explicit --processes, else the
+        daemon's own status-file claim — so a watch started before
+        follower streams exist still waits for them instead of
+        silently degrading to a leader-only 'consistency ok'."""
+        if self._processes is not None:
+            return self._processes
+        n = self.status.get("num_processes")
+        if isinstance(n, int) and n >= 1:
+            return n
+        return None   # glob discovery (single-process dirs)
+
+    def poll(self) -> None:
+        self._refresh_status()
+        expected = self._expected()
+        if expected is not None and expected > len(self.agg.processes):
+            # Followers appeared (or the daemon finally wrote its
+            # status) after we built the aggregator: rebuild over the
+            # full fleet — a restarted merge beats a silent
+            # leader-only view.
+            self.agg = self._build()
+        try:
+            self.agg.poll()
+            self.error = None
+        except ValueError as e:
+            # Mid-file garbage: render the error, keep watching.
+            self.error = str(e)
+        # The live watch consumes stats()/alerts(), never the merged
+        # event list — drop it, or a days-long watch of a resident
+        # fleet retains every event of every process in memory.
+        self.agg.drain_merged()
+
+    def critical(self) -> bool:
+        return (self.agg.divergence is not None
+                or any(a.get("severity") == "critical"
+                       for a in self.agg.alerts()))
+
+    def render(self) -> str:
+        from dopt.obs.aggregate import format_fleet_divergence
+
+        now = time.time()  # dopt: allow-wallclock -- lag column vs event ts stamps, display only
+        stats = self.agg.stats(now)
+        status = self.status
+        head = f"dopt fleet watch — {self.state_dir}"
+        bits = []
+        if status.get("status"):
+            bits.append(status["status"])
+        if status.get("admin_port"):
+            bits.append(f"admin :{status['admin_port']}")
+        if stats["fleet_round"] is not None:
+            bits.append(f"fleet round {stats['fleet_round']}")
+        if bits:
+            head += "  [" + ", ".join(bits) + "]"
+        lines = [head]
+        if self.error:
+            lines.append(f"  STREAM ERROR: {self.error}")
+        lines.append("  proc  round     rounds/s  loss          "
+                     "lag(s)  segs  alerts")
+        for p, snap in sorted(stats["processes"].items()):
+            loss = snap["loss"]
+            rps = snap["rounds_per_sec"]
+            lag = snap["lag_seconds"]
+            lines.append(
+                f"  p{p:<4} "
+                f"{str('-' if snap['round'] is None else snap['round']):<9} "
+                f"{f'{rps:.3f}' if rps else '-':<9} "
+                f"{f'{loss:.6g}' if isinstance(loss, (int, float)) else '-':<13} "
+                f"{f'{lag:.1f}' if lag is not None else '-':<7} "
+                f"{snap['segments']:<5} {snap['alerts']}")
+        if self.agg.divergence is not None:
+            lines.append("  CONSISTENCY: DIVERGED")
+            lines.extend("  " + line for line in
+                         format_fleet_divergence(self.agg.divergence)
+                         .splitlines())
+        else:
+            lines.append(f"  consistency ok through round "
+                         f"{stats['fleet_round'] if stats['fleet_round'] is not None else '-'} "
+                         f"({stats['rounds_merged']} rounds verified, "
+                         f"{stats['merged_events']} merged events)")
+        alerts = self.agg.alerts()
+        for a in alerts[-5:]:
+            lines.append(f"  ALERT [{a.get('severity')}] "
+                         f"p{a.get('process')} {a.get('rule')} @ round "
+                         f"{a.get('round')}: {a.get('message')}")
+        return "\n".join(lines)
+
+
+def watch_fleet(args) -> int:
+    state = FleetWatchState(args.state_dir, processes=args.processes)
+    try:
+        while True:
+            state.poll()
+            if args.once:
+                print(state.render())
+                # Corrupt streams fail the exit-code contract too:
+                # check/aggregate exit 1 on the same dir, so must the
+                # scripted one-shot watch.
+                return 1 if (state.critical()
+                             or state.error is not None) else 0
+            if not args.no_clear:
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print(state.render(), flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("metrics", metavar="METRICS_JSONL")
+    ap.add_argument("metrics", nargs="?", default=None,
+                    metavar="METRICS_JSONL")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="FLEET mode: watch every process stream of a "
+                         "dopt serve state dir (metrics.jsonl + "
+                         "metrics-p<i>.jsonl), one merged view with "
+                         "per-process columns and alert provenance")
+    ap.add_argument("--processes", type=int, default=None, metavar="N",
+                    help="fleet mode: expected fleet size (default: "
+                         "discover follower streams by glob)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period, seconds")
     ap.add_argument("--once", action="store_true",
@@ -196,6 +356,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="show only these gauges (comma-separated); "
                          "default shows every gauge in the stream")
     args = ap.parse_args(argv)
+
+    if args.state_dir is not None:
+        return watch_fleet(args)
+    if args.metrics is None:
+        ap.error("give a METRICS_JSONL path or --state-dir")
 
     monitor = HealthMonitor(workers=args.workers)
     gauge_filter = (set(g.strip() for g in args.gauges.split(",")

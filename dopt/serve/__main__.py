@@ -80,6 +80,16 @@ def run_daemon(args, argv: list[str]) -> int:
                                 args.num_processes,
                                 args.devices_per_proc)
     cfg = build_cfg(args)
+    rules = None
+    if args.rules_file:
+        from dopt.serve.daemon import serve_rules
+
+        specs = json.loads(Path(args.rules_file).read_text())
+        if not isinstance(specs, list):
+            raise SystemExit(f"--rules-file {args.rules_file}: expected "
+                             "a JSON list of rule specs "
+                             '([{"rule": <name>, ...}, ...])')
+        rules = serve_rules(specs=specs)
     daemon = ServeDaemon(
         cfg, args.state_dir,
         checkpoint_every=args.checkpoint_every,
@@ -89,6 +99,7 @@ def run_daemon(args, argv: list[str]) -> int:
         admin_port=None if args.no_admin else args.admin_port,
         process_id=args.process_id or 0,
         num_processes=args.num_processes,
+        rules=rules,
     ).start()
     if daemon.is_leader and daemon.admin is not None:
         print(f"dopt serve: admin on http://{args.admin_host}:"
@@ -137,6 +148,36 @@ def run_supervisor(args, argv: list[str]) -> int:
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
 
+    # The ONE fleet observability surface: every process streams its
+    # own metrics file; the supervisor mounts the merged + verified
+    # view (dopt.obs.aggregate) as /metrics + /healthz, port announced
+    # in <state>/fleet.json.  Stdlib-only — the supervisor never
+    # imports jax.
+    fleet_server = None
+    if not args.no_admin:
+        from dopt.obs.aggregate import FleetMetricsServer
+        from dopt.utils.metrics import atomic_write_text
+
+        fleet_server = FleetMetricsServer(
+            state, num_processes=args.num_processes,
+            host=args.admin_host, port=args.fleet_port).start()
+        atomic_write_text(state / "fleet.json", json.dumps(
+            {"host": args.admin_host, "port": fleet_server.port,
+             "pid": os.getpid(),
+             "num_processes": args.num_processes}, indent=2))
+        print(f"dopt serve: fleet metrics on http://{args.admin_host}:"
+              f"{fleet_server.port} (/metrics, /healthz)",
+              file=sys.stderr, flush=True)
+
+    try:
+        return _supervise(args, argv, state)
+    finally:
+        if fleet_server is not None:
+            fleet_server.shutdown()
+            (state / "fleet.json").unlink(missing_ok=True)
+
+
+def _supervise(args, argv: list[str], state: Path) -> int:
     log_dir = state / "logs"
     log_dir.mkdir(parents=True, exist_ok=True)
     generation = 0
@@ -245,8 +286,19 @@ def main(argv: list[str] | None = None) -> int:
                          "ephemeral; the bound port lands in "
                          "<state>/serve.json)")
     ap.add_argument("--no-admin", action="store_true",
-                    help="run without the HTTP endpoint (file-queue "
-                         "control only)")
+                    help="run without the HTTP endpoints (file-queue "
+                         "control only; also disables the supervisor's "
+                         "fleet metrics endpoint)")
+    ap.add_argument("--fleet-port", type=int, default=0,
+                    help="multi-process supervisor's fleet /metrics + "
+                         "/healthz port (default 0 = ephemeral; the "
+                         "bound port lands in <state>/fleet.json)")
+    ap.add_argument("--rules-file", default=None, metavar="PATH",
+                    help="JSON list of monitor rule specs "
+                         '([{"rule": <name>, ...}]; dopt.obs.rules.'
+                         "build_rules shape) REPLACING the stock rule "
+                         "set — the escalated drop_rate_critical "
+                         "auto-pause rule is always appended")
     ap.add_argument("--num-processes", type=int, default=1,
                     help="multi-process fleet size (real "
                          "jax.distributed + gloo CPU collectives)")

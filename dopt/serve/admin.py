@@ -20,12 +20,26 @@ file and ``GET /admin/status``):
 * ``POST /admin/checkpoint`` checkpoint at the next boundary;
 * ``POST /admin/drain``      ``{"restart": true?}`` — drain the run
                              (optionally asking for a re-exec);
-* ``POST /admin/pause`` / ``POST /admin/resume``  — admission control.
+* ``POST /admin/pause`` / ``POST /admin/resume``  — admission control;
+* ``POST /admin/profile``    ``{"rounds": K}`` — arm an on-demand
+                             ``jax.profiler`` capture for the next K
+                             rounds; the daemon writes a Chrome-trace
+                             artifact (device trace merged with the
+                             host spans) under ``<state>/profile/``.
+                             GET returns the capture status +
+                             artifact paths.  Pure observability: NOT
+                             a queued command, never ledgered, and
+                             pinned to leave History / fault ledger /
+                             canonical stream bit-identical;
+* every 503 carries a ``Retry-After`` header and a JSON body, and
+  ``/healthz`` includes the monitor's own ``lag_seconds`` (wall since
+  the newest event) so a stalled producer is distinguishable from a
+  healthy idle one.
 
-POSTs append to the command queue and return 202 with the command id;
-commands take effect at the next eligible round boundary and are
-ledgered there — the endpoint never mutates training state directly,
-so everything it does is replayable from the applied ledger.
+Command POSTs append to the command queue and return 202 with the
+command id; commands take effect at the next eligible round boundary
+and are ledgered there — the endpoint never mutates training state
+directly, so everything it does is replayable from the applied ledger.
 """
 
 from __future__ import annotations
@@ -47,9 +61,9 @@ _POST_COMMANDS = {
 }
 
 _HELP = (b"dopt serve admin: GET /metrics /healthz /admin/status "
-         b"/admin/config /admin/membership; POST /admin/config "
-         b"/admin/membership /admin/checkpoint /admin/drain "
-         b"/admin/pause /admin/resume\n")
+         b"/admin/config /admin/membership /admin/profile; POST "
+         b"/admin/config /admin/membership /admin/checkpoint "
+         b"/admin/drain /admin/pause /admin/resume /admin/profile\n")
 
 
 class AdminServer:
@@ -84,7 +98,8 @@ class AdminServer:
             return 200, _HELP, "text/plain"
         if path == "/metrics":
             if d.prom is None:
-                return 503, b"telemetry not attached\n", "text/plain"
+                return (503, b'{"error": "telemetry not attached"}\n',
+                        "application/json")
             return (200, d.prom.render().encode(),
                     "text/plain; version=0.0.4; charset=utf-8")
         if path == "/healthz":
@@ -94,6 +109,11 @@ class AdminServer:
             report = self._report()
             body = report.to_dict()
             body["serve"] = d.snapshot()
+            # The monitor's own staleness (wall seconds since the
+            # newest event): a stalled producer and a healthy idle one
+            # report the same verdict — the lag tells them apart.
+            body["last_event_ts"] = d.monitor.last_event_ts
+            body["lag_seconds"] = d.monitor.lag_seconds()
             return (200 if report.ok else 503,
                     json.dumps(body, indent=2).encode(), "application/json")
         if path == "/admin/status":
@@ -104,6 +124,9 @@ class AdminServer:
                     "application/json")
         if path == "/admin/membership":
             return (200, json.dumps(d.membership_snapshot(),
+                                    indent=2).encode(), "application/json")
+        if path == "/admin/profile":
+            return (200, json.dumps(d.profile_status(),
                                     indent=2).encode(), "application/json")
         return 404, b"not found\n", "text/plain"
 
@@ -119,6 +142,17 @@ class AdminServer:
         return self.daemon.monitor.report()
 
     def _post(self, path: str, body: dict[str, Any]) -> tuple[int, bytes]:
+        if path == "/admin/profile":
+            # NOT a queued command: profiling is observability, must
+            # never enter the applied ledger (a profiled run replays
+            # identically to an unprofiled one).
+            try:
+                status = self.daemon.request_profile(
+                    body.get("rounds", 1))
+            except (TypeError, ValueError) as e:
+                return 400, json.dumps({"error": str(e)}).encode() + b"\n"
+            return 202, json.dumps(
+                {"armed": True, **status}).encode() + b"\n"
         cmd_kind = _POST_COMMANDS.get(path)
         if cmd_kind is None:
             return 404, b'{"error": "not found"}\n'
@@ -168,11 +202,9 @@ class AdminServer:
                 self._reply(code, out, "application/json")
 
             def _reply(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                from dopt.obs.serve import http_reply
+
+                http_reply(self, code, body, ctype)
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 pass   # scrapes would flood the daemon's stderr

@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from pathlib import Path
 from typing import Any, Iterable
 
@@ -118,6 +119,14 @@ def validate_command(obj: Any) -> dict[str, Any]:
         r = obj["at_round"]
         if not isinstance(r, int) or isinstance(r, bool) or r < 0:
             _fail("at_round must be an int >= 0", obj)
+    if "ts" in obj:
+        # The enqueue wall-clock stamp (CommandQueue.submit adds it):
+        # the start point of the command_apply SLO latency.  Advisory
+        # metadata, never replay data.
+        t = obj["ts"]
+        if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                or t < 0:
+            _fail("ts must be a number >= 0", obj)
     if cmd == "config":
         key = obj.get("key")
         if key not in CONFIG_WHITELIST:
@@ -168,6 +177,10 @@ class CommandQueue:
 
     def submit(self, command: dict[str, Any]) -> dict[str, Any]:
         command = validate_command(dict(command))
+        # Enqueue stamp for the command_apply SLO latency (enqueue ts →
+        # applied ts); pre-stamped commands (a replayed script) keep
+        # their own.
+        command.setdefault("ts", round(time.time(), 6))  # dopt: allow-wallclock -- command_apply SLO latency enqueue stamp, advisory metadata
         with self._lock:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             with open(self.path, "a+", encoding="utf-8") as f:

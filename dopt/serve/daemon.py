@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import threading
 import time
 from pathlib import Path
@@ -115,16 +116,19 @@ class _LockedPrometheusSink:
         pass
 
 
-def serve_rules(extra_drop_rate: float = 0.5):
-    """The daemon's stock rule set: ``default_rules()`` plus an
-    ESCALATED drop-rate instance at critical severity — the signal the
-    admission auto-pause keys on.  The escalation threshold (lost
+def serve_rules(extra_drop_rate: float = 0.5, specs=None):
+    """The daemon's monitor rule set: ``default_rules()`` — or, with
+    ``specs`` (the ``build_rules`` list shape a ``--rules-file`` JSON
+    carries), the operator's declarative set instead — plus an
+    ESCALATED drop-rate instance at critical severity, ALWAYS appended:
+    that is the signal the admission auto-pause keys on, and a rule
+    swap must not silently disarm it.  The escalation threshold (lost
     contributions per participant-round) is far above anything a
     healthy fleet produces, so the clean-run false-positive gate still
     holds."""
-    from dopt.obs.rules import DropRateRule, default_rules
+    from dopt.obs.rules import DropRateRule, build_rules, default_rules
 
-    rules = default_rules()
+    rules = build_rules(specs) if specs is not None else default_rules()
     esc = DropRateRule(max_rate=float(extra_drop_rate), window=4,
                        min_rounds=2)
     esc.name = "drop_rate_critical"
@@ -166,7 +170,14 @@ class ServeDaemon:
         self.queue = CommandQueue(self.state_dir / _COMMANDS_FILE)
         self.ledger = ControlLedger(self.state_dir / _APPLIED_FILE)
         self.ckpt_path = self.state_dir / _CKPT_DIR
-        self.metrics_path = self.state_dir / _METRICS_FILE
+        # EVERY process streams telemetry: the leader to metrics.jsonl,
+        # followers to metrics-p<i>.jsonl — followers replay the
+        # leader's directives, so the deterministic kinds of all N
+        # streams must be bit-identical, which is exactly what the
+        # fleet aggregator (dopt.obs.aggregate) verifies.
+        self.metrics_path = self.state_dir / (
+            _METRICS_FILE if self.process_id == 0
+            else f"metrics-p{self.process_id}.jsonl")
 
         self.trainer = None
         self.telemetry = None
@@ -184,6 +195,20 @@ class ServeDaemon:
         self._last_ckpt = -1
         self._alerts_seen = 0
         self._resumed = False
+        # On-demand live profiling (POST /admin/profile): an armed
+        # request captures a jax.profiler trace for the next K rounds
+        # and writes a Chrome-trace artifact merged with the host
+        # spans.  Pure observability — no ledger row, no telemetry
+        # event, no training-state effect: arming it leaves History,
+        # fault ledger and canonical stream bit-identical.
+        self._profile_pending = 0
+        self._profile: dict[str, Any] | None = None
+        self._profile_artifacts: list[str] = []
+        # Guards the armed/active transitions: POSTs arrive on the
+        # admin's ThreadingHTTPServer threads while the serve thread
+        # consumes the arm at boundaries — without it two concurrent
+        # POSTs could both pass the already-armed check and both 202.
+        self._profile_lock = threading.Lock()
         # Per-process boundary visit counter: a config-change rebuild
         # REVISITS the same round boundary, so directives are keyed by
         # (visit sequence, round), never round alone — SPMD lockstep
@@ -215,18 +240,21 @@ class ServeDaemon:
         self.trainer = build_serve_trainer(self.cfg, self.membership)
         if not self.is_leader:
             self.trainer.checkpoint_writer = False
+        restore_s: float | None = None
         if resume_round is not None:
+            t0 = time.perf_counter()  # dopt: allow-wallclock -- checkpoint_restore SLO latency meter, reporting only
             self.trainer.restore(self.ckpt_path)
+            restore_s = time.perf_counter() - t0  # dopt: allow-wallclock -- checkpoint_restore SLO latency meter, reporting only
             self._resumed = True
             self.restarts += 1
         self._last_ckpt = int(self.trainer.round) if self._resumed else -1
 
-        if self.is_leader:
-            from dopt.obs import HealthMonitor, Telemetry, attach
+        from dopt.obs import HealthMonitor, Telemetry, attach
 
-            self.telemetry = Telemetry.to_jsonl(self.metrics_path,
-                                                resume=True)
-            stream_watermark = self.telemetry.watermark
+        self.telemetry = Telemetry.to_jsonl(self.metrics_path,
+                                            resume=True)
+        stream_watermark = self.telemetry.watermark
+        if self.is_leader:
             self.prom = _LockedPrometheusSink()
             self.telemetry.sinks.append(self.prom)
             mon_state = None
@@ -241,32 +269,35 @@ class ServeDaemon:
                 workers=self.trainer.num_workers, state=mon_state)
             self.monitor.attach(self.telemetry)
             self._alerts_seen = len(self.monitor.alerts)
-            attach(self.trainer, self.telemetry,
-                   checkpoint_every=self.checkpoint_every or None)
-            if self._resumed and stream_watermark <= int(self.trainer.round):
-                # Commands applied at EXACTLY the resume boundary may
-                # have lost their control events: the event trails the
-                # last sealed round, so repair_tail can drop it on
-                # reopen (and a kill window can lose it outright) —
-                # while one shielded by a later non-droppable event
-                # (e.g. the boundary's `checkpoint`) survives.  Re-emit
-                # exactly the MISSING ones, by id, so the resumed
-                # stream carries each applied command once.
-                r = int(self.trainer.round)
-                present = self._stream_control_ids(r)
-                for rec in records:
-                    if rec.get("status") == "applied" \
-                            and int(rec.get("round", -1)) == r \
-                            and str(rec.get("id")) not in present:
-                        self.telemetry.emit(
-                            "control",
-                            **control_event_fields(
-                                rec, r, auto=bool(rec.get("auto"))))
-            if self.admin_port is not None:
-                from dopt.serve.admin import AdminServer
+        attach(self.trainer, self.telemetry,
+               checkpoint_every=self.checkpoint_every or None)
+        if restore_s is not None:
+            self._observe_latency("checkpoint_restore", restore_s,
+                                  int(self.trainer.round))
+        if self._resumed and stream_watermark <= int(self.trainer.round):
+            # Commands applied at EXACTLY the resume boundary may
+            # have lost their control events: the event trails the
+            # last sealed round, so repair_tail can drop it on
+            # reopen (and a kill window can lose it outright) —
+            # while one shielded by a later non-droppable event
+            # (e.g. the boundary's `checkpoint`) survives.  Re-emit
+            # exactly the MISSING ones, by id, so the resumed
+            # stream carries each applied command once.
+            r = int(self.trainer.round)
+            present = self._stream_control_ids(r)
+            for rec in records:
+                if rec.get("status") == "applied" \
+                        and int(rec.get("round", -1)) == r \
+                        and str(rec.get("id")) not in present:
+                    self.telemetry.emit(
+                        "control",
+                        **control_event_fields(
+                            rec, r, auto=bool(rec.get("auto"))))
+        if self.is_leader and self.admin_port is not None:
+            from dopt.serve.admin import AdminServer
 
-                self.admin = AdminServer(self, host=self.admin_host,
-                                         port=self.admin_port).start()
+            self.admin = AdminServer(self, host=self.admin_host,
+                                     port=self.admin_port).start()
         self._install_signals()
         self.status = "serving"
         self._write_status()
@@ -339,6 +370,7 @@ class ServeDaemon:
 
     # -- the run_served controller ------------------------------------
     def boundary(self, trainer) -> str:
+        tick0 = time.perf_counter()  # dopt: allow-wallclock -- boundary_tick SLO latency meter, reporting only
         t = int(trainer.round)
         self._boundary_seq += 1
         if self.num_processes > 1 and not self.is_leader:
@@ -347,7 +379,29 @@ class ServeDaemon:
             directive = self._decide(t, trainer)
             if self.num_processes > 1:
                 self._publish_directive(self._boundary_seq, directive)
-        return self._execute(directive, trainer)
+        verdict = self._execute(directive, trainer)
+        # boundary_tick measures the CONTROL-PLANE work (ingest,
+        # directive, apply, checkpoint decision) — the profile tick
+        # runs after the meter so a capture's artifact write never
+        # skews the SLO.
+        self._observe_latency(
+            "boundary_tick",
+            time.perf_counter() - tick0, t)  # dopt: allow-wallclock -- boundary_tick SLO latency meter, reporting only
+        self._profile_tick(t, verdict)
+        return verdict
+
+    def _observe_latency(self, name: str, seconds: float,
+                         round_idx: int) -> None:
+        """Stream one SLO latency observation (``dopt.obs.latency``):
+        a non-deterministic v1 ``latency`` event — wall durations, so
+        like resource/compile it stays outside the canonical
+        comparison; the in-process monitor folds it into the histogram
+        the HealthReport and ``final.json`` summarize."""
+        if self.telemetry is None:
+            return
+        self.telemetry.emit(
+            "latency", round=max(int(round_idx), 0), name=str(name),
+            seconds=round(max(float(seconds), 0.0), 6))  # dopt: allow-nondet-event -- SLO latency channel, documented non-deterministic like resource/compile
 
     def _decide(self, t: int, trainer) -> dict[str, Any]:
         """Leader: resolve this boundary completely (what applies, what
@@ -453,9 +507,20 @@ class ServeDaemon:
                 self.ledger.append(applied_record(c, status="applied",
                                                   round_idx=t, auto=auto))
                 self._processed.add(str(c["id"]))
-                if self.telemetry is not None:
-                    self.telemetry.emit(
-                        "control", **control_event_fields(c, t, auto=auto))
+            if self.telemetry is not None:
+                # EVERY process's stream carries the deterministic
+                # control event (followers replay the directive, so
+                # leader and follower streams must agree — the fleet
+                # aggregator's consistency check).
+                self.telemetry.emit(
+                    "control", **control_event_fields(c, t, auto=auto))
+            ets = c.get("ts")
+            if isinstance(ets, (int, float)):
+                # enqueue → applied: the latency an operator actually
+                # waits on a command (the queue stamps `ts` at submit).
+                self._observe_latency(
+                    "command_apply",
+                    time.time() - float(ets), t)  # dopt: allow-wallclock -- command_apply SLO latency vs the queue ts stamp, reporting only
             done_ids.add(str(c.get("id")))
         if done_ids:
             self._pending = [c for c in self._pending
@@ -491,6 +556,7 @@ class ServeDaemon:
         # checkpoint/drain effects are carried by the directive itself.
 
     def _checkpoint(self, trainer, t: int) -> None:
+        t0 = time.perf_counter()  # dopt: allow-wallclock -- checkpoint_save SLO latency meter, reporting only
         trainer.save(self.ckpt_path)
         if self.num_processes > 1:
             # The save's allgather is collective; the barrier keeps
@@ -499,6 +565,9 @@ class ServeDaemon:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"dopt-serve-ckpt-{t}")
+        self._observe_latency(
+            "checkpoint_save",
+            time.perf_counter() - t0, t)  # dopt: allow-wallclock -- checkpoint_save SLO latency meter, reporting only
         if self.is_leader and self.monitor is not None:
             from dopt.utils.metrics import atomic_write_text
 
@@ -552,6 +621,117 @@ class ServeDaemon:
             f"{t} (visit {seq}) after {self._directive_max_polls} polls "
             "— leader gone?")
 
+    # -- on-demand live profiling (POST /admin/profile) ----------------
+    def request_profile(self, rounds: int) -> dict[str, Any]:
+        """Arm a ``jax.profiler`` trace capture for the next ``rounds``
+        training rounds (admin thread; takes effect at the next
+        boundary).  Observability only: no ledger row, no telemetry
+        event, no training-state effect — arming it leaves History,
+        fault ledger and canonical stream bit-identical to an
+        unprofiled run."""
+        rounds = int(rounds)
+        if not 1 <= rounds <= 10_000:
+            raise ValueError(
+                f"profile rounds must be in [1, 10000], got {rounds}")
+        with self._profile_lock:
+            if self._profile is not None or self._profile_pending:
+                raise ValueError(
+                    "a profile capture is already armed or active "
+                    f"({self.profile_status()})")
+            self._profile_pending = rounds
+        return self.profile_status()
+
+    def profile_status(self) -> dict[str, Any]:
+        prof = self._profile
+        return {
+            "pending_rounds": self._profile_pending,
+            "active": None if prof is None else {
+                "start_round": prof["start"], "rounds": prof["rounds"]},
+            "artifacts": list(self._profile_artifacts),
+        }
+
+    def _profile_tick(self, t: int, verdict: str) -> None:
+        """Boundary hook: stop a capture whose window elapsed (or whose
+        run is stopping), then start an armed one.  Runs strictly
+        outside the round dispatch — the capture wraps whole rounds."""
+        prof = self._profile
+        if prof is not None and (verdict != "run"
+                                 or t >= prof["start"] + prof["rounds"]):
+            self._profile_stop(t)
+        with self._profile_lock:
+            if verdict == "run" and self._profile_pending \
+                    and self._profile is None:
+                rounds, self._profile_pending = self._profile_pending, 0
+                self._profile_start(t, rounds)
+
+    def _profile_start(self, t: int, rounds: int) -> None:
+        import jax
+
+        trace_dir = self.state_dir / "profile" / f"r{t}"
+        trace_dir.mkdir(parents=True, exist_ok=True)
+        try:
+            jax.profiler.start_trace(str(trace_dir))
+        except Exception as e:   # profiler already active, backend quirk
+            print(f"dopt serve: profile capture failed to start: {e}",
+                  file=sys.stderr, flush=True)
+            return
+        self._profile = {"start": t, "rounds": int(rounds),
+                         "dir": str(trace_dir)}
+        print(f"dopt serve: profiling armed for {rounds} round(s) "
+              f"from round {t}", file=sys.stderr, flush=True)
+
+    def _profile_stop(self, t: int) -> None:
+        import jax
+
+        prof, self._profile = self._profile, None
+        if prof is None:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            print(f"dopt serve: profile capture failed to stop: {e}",
+                  file=sys.stderr, flush=True)
+            return
+        try:
+            artifact = self._write_profile_artifact(prof, t)
+        except (OSError, ValueError) as e:
+            print(f"dopt serve: profile artifact failed: {e}",
+                  file=sys.stderr, flush=True)
+            return
+        self._profile_artifacts.append(str(artifact))
+        print(f"dopt serve: profile artifact {artifact} "
+              f"(rounds {prof['start']}..{t})", file=sys.stderr,
+              flush=True)
+
+    def _write_profile_artifact(self, prof: dict[str, Any],
+                                t: int) -> Path:
+        """Merge the XLA trace the profiler dumped with the telemetry
+        span tracer's host spans into ONE loadable Chrome trace: device
+        events keep their pids, host spans ride a dedicated synthetic
+        process track."""
+        import gzip
+
+        from dopt.utils.metrics import atomic_write_text
+
+        events: list[dict[str, Any]] = []
+        for gz in sorted(Path(prof["dir"]).glob("**/*.trace.json.gz")):
+            with gzip.open(gz, "rt") as fh:
+                data = json.load(fh)
+            events.extend(data.get("traceEvents", []))
+        host_pid = 900_000 + self.process_id
+        if self.telemetry is not None:
+            spans = self.telemetry.tracer.to_chrome()
+            if spans:
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": host_pid,
+                               "args": {"name": "dopt host spans"}})
+                events.extend({**s, "pid": host_pid} for s in spans)
+        out = (self.state_dir / "profile"
+               / f"profile-r{prof['start']}-r{t}.trace.json")
+        atomic_write_text(out, json.dumps(
+            {"traceEvents": events, "displayTimeUnit": "ms"}))
+        return out
+
     # -- the serve loop ------------------------------------------------
     def serve(self) -> int:
         """Run until drained (returns 0) or told to restart (returns
@@ -576,16 +756,25 @@ class ServeDaemon:
         trainer = build_serve_trainer(self.cfg, self.membership)
         if not self.is_leader:
             trainer.checkpoint_writer = False
+        t0 = time.perf_counter()  # dopt: allow-wallclock -- checkpoint_restore SLO latency meter, reporting only
         trainer.restore(self.ckpt_path)
+        restore_s = time.perf_counter() - t0  # dopt: allow-wallclock -- checkpoint_restore SLO latency meter, reporting only
         if self.telemetry is not None:
             from dopt.obs import attach
 
             attach(trainer, self.telemetry,
                    checkpoint_every=self.checkpoint_every or None)
         self.trainer = trainer
+        self._observe_latency("checkpoint_restore", restore_s,
+                              int(trainer.round))
 
     def _finalize(self, status: str) -> None:
         self.status = status
+        if self._profile is not None:
+            # A drain/restart landed mid-capture: close the trace and
+            # write the (partial) artifact rather than leaking an
+            # active profiler session into process exit.
+            self._profile_stop(int(getattr(self.trainer, "round", 0)))
         if self.is_leader:
             # Consume any follower stop request on the way out — a
             # stale flag would stop the next serve of this state dir
@@ -599,12 +788,20 @@ class ServeDaemon:
 
                 report = (self.monitor.report().to_dict()
                           if self.monitor is not None else None)
+                # The SLO latency summary (p50/p95/p99 per name): the
+                # monitor's histograms accumulate from the latency
+                # events and are checkpointed with its state, so a
+                # restarted run's drain still summarizes the whole
+                # run's latencies.
+                slo = (report or {}).get("latency") or {}
                 atomic_write_text(self.state_dir / _FINAL_FILE, json.dumps({
                     "round": int(self.trainer.round),
                     "history": self.trainer.history.rows,
                     "fault_ledger": self.trainer.history.faults,
                     "restarts": self.restarts,
                     "report": report,
+                    "slo": slo,
+                    "profiles": list(self._profile_artifacts),
                 }, indent=2))
         if self.admin is not None:
             self.admin.shutdown()
@@ -635,6 +832,7 @@ class ServeDaemon:
             "engine": getattr(trainer, "engine_kind", None),
             "max_rounds": self.max_rounds,
             "num_processes": self.num_processes,
+            "profile": self.profile_status(),
         }
 
     def membership_snapshot(self) -> dict[str, Any]:

@@ -26,8 +26,32 @@ and asserts the four things a resident trainer owes you:
    either leg, and the daemon's own in-process monitor (stock set +
    the escalated drop-rate rule) reports healthy.
 
+Stream equality is asserted through ``python -m dopt.obs.diff`` (the
+first-divergence differ this soak's inline assert grew into), so a
+red run names the exact diverging event instead of "streams differ".
+
+Two further modes:
+
+* ``--fleet`` — a REAL 2-process ``jax.distributed`` fleet leg with a
+  live membership + config change and a SIGTERM rolling restart of a
+  follower; every process streams its own telemetry, and the
+  ``dopt.obs.aggregate`` fleet aggregator must verify cross-process
+  DETERMINISTIC_KINDS consistency through the restart, produce a
+  merged stream that passes ``dopt.obs.check``, and yield an SLO
+  report with finite p50/p99 for boundary-tick, command-apply,
+  checkpoint-save/restore and alert latency (a sensitized drop-rate
+  rule turns the membership churn into a real measured alert).
+* ``--minutes N`` — the LONG soak (the ROADMAP 1-hour item as a flag,
+  not a rewrite): a resident run kept alive for N wall minutes under
+  seeded randomized live churn (membership leave/join, lr and
+  checkpoint-cadence config churn, SIGTERM rolling restarts on a
+  timer), drained at the deadline, with the SLO latency report
+  (p50/p99 per name) written to ``--slo-out``.
+
     python scripts/serve_soak.py --rounds 48 --min-seconds 60
     python scripts/serve_soak.py --engine federated --rounds 24
+    python scripts/serve_soak.py --fleet --rounds 40 --slo-out slo.json
+    python scripts/serve_soak.py --minutes 20 --slo-out slo.json
 """
 
 from __future__ import annotations
@@ -145,6 +169,7 @@ def run_leg(name: str, state_dir: Path, argv: list[str], *,
 
 def check_streams(path_a: Path, path_b: Path, rounds: int) -> None:
     from dopt.obs import HealthMonitor, JsonlSink, canonical, check_stream
+    from dopt.obs.diff import diverge_canonical, format_divergence
 
     ev_a = JsonlSink.read(path_a)
     ev_b = JsonlSink.read(path_b)
@@ -152,8 +177,13 @@ def check_streams(path_a: Path, path_b: Path, rounds: int) -> None:
     assert sa["rounds"] == sb["rounds"] == rounds, (sa, sb)
     assert sb["segments"] >= sa["segments"] + 1, \
         "restarted leg should carry at least one extra segment header"
-    ca, cb = canonical(ev_a), canonical(ev_b)
-    assert ca == cb, "canonical streams diverged between legs"
+    # The first-divergence differ IS the equality assert now: a red
+    # run names the exact diverging canonical event.  The CLI form
+    # (`python -m dopt.obs.diff A B`) is the same code path.
+    ca = canonical(ev_a)
+    div = diverge_canonical(ca, canonical(ev_b))
+    assert div is None, "canonical streams diverged between legs:\n" \
+        + format_divergence(str(path_a), str(path_b), div)
     n_ctl = sum(1 for e in ca if e["kind"] == "control")
     assert n_ctl == 3, f"expected 3 applied control events, saw {n_ctl}"
     print(f"[streams] canonical equality ok: {sa['events']} vs "
@@ -169,6 +199,304 @@ def check_streams(path_a: Path, path_b: Path, rounds: int) -> None:
     print("[streams] zero stock-rule alerts on both legs", flush=True)
 
 
+# Sensitized monitor rule set for the latency-measuring legs: a
+# drop-rate instance tight enough that the scripted membership churn
+# fires a REAL warn alert through the real in-process path — which is
+# what makes `alert_latency` a measured number instead of an empty
+# histogram.  (The daemon always appends its escalated
+# drop_rate_critical auto-pause rule on top; 0.02 << 0.5 never
+# triggers the pause.)
+SENSITIZED_RULES = [{"rule": "drop_rate", "max_rate": 0.02,
+                     "window": 4, "min_rounds": 2}]
+
+# The SLO names the fleet/long legs must report finite p50/p99 for
+# (dopt.obs.latency.SLO_LATENCIES, restated here so the soak fails
+# loudly if the contract drifts).
+SLO_CORE = ("boundary_tick", "command_apply", "checkpoint_save",
+            "checkpoint_restore")
+
+
+def write_slo_report(path: str, payload: dict) -> None:
+    from dopt.utils.metrics import atomic_write_text
+
+    atomic_write_text(path, json.dumps(payload, indent=2))
+    print(f"wrote SLO report to {path}", flush=True)
+    for name, s in sorted(payload.get("slo", {}).items()):
+        print(f"[slo] {name}: n={s['count']} p50={s['p50']}s "
+              f"p99={s['p99']}s max={s['max']}s", flush=True)
+
+
+def assert_slo(slo: dict, names) -> None:
+    for name in names:
+        s = slo.get(name)
+        assert s and s["count"] >= 1, \
+            f"SLO report misses latency {name!r}: {sorted(slo)}"
+        for q in ("p50", "p99"):
+            v = s.get(q)
+            assert isinstance(v, (int, float)), \
+                f"SLO {name}.{q} not finite: {s}"
+
+
+def sigterm_child(state_dir: Path, process_id: int) -> bool:
+    """SIGTERM one fleet child by its --process-id (the rolling-restart
+    trigger).  No leading dashes in the pgrep pattern — it would parse
+    them as its own options."""
+    out = subprocess.run(
+        ["pgrep", "-f", f"state-dir {state_dir}.*process-id "
+                        f"{process_id}"],
+        capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split()]
+    if not pids:
+        return False
+    os.kill(pids[0], signal.SIGTERM)
+    return True
+
+
+def run_fleet_soak(args, root: Path) -> int:
+    """The 2-process fleet leg: real ``jax.distributed`` + gloo, live
+    membership + config change, SIGTERM rolling restart of a follower —
+    then the fleet aggregator must verify cross-process consistency,
+    its merged stream must pass ``dopt.obs.check``, and the SLO report
+    must carry finite p50/p99 for every core latency plus
+    alert_latency."""
+    from dopt.obs import JsonlSink, summarize_latency_events
+    from dopt.utils.metrics import atomic_write_text
+
+    state = root / "fleet"
+    if state.exists():
+        import shutil
+
+        shutil.rmtree(state)
+    state.mkdir(parents=True)
+    rounds = args.rounds
+    marks = seed_commands(state, rounds)
+    kill_at = max(3 * rounds // 8, 2)
+    rules_file = root / "fleet-rules.json"
+    atomic_write_text(rules_file, json.dumps(SENSITIZED_RULES))
+
+    cmd = [sys.executable, "-m", "dopt.serve",
+           *serve_args(args.engine, rounds, args.seed,
+                       args.checkpoint_every),
+           "--state-dir", str(state), "--rules-file", str(rules_file),
+           "--num-processes", "2", "--devices-per-proc", "2"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    print(f"[fleet] engine={args.engine} rounds={rounds} commands at "
+          f"{marks}, follower SIGTERM at >= {kill_at}", flush=True)
+    t0 = time.time()
+    sup = subprocess.Popen(cmd, env=env, cwd=REPO)
+    status_path = state / "serve.json"
+    killed = False
+    timeout_s = 1500.0
+    while sup.poll() is None:
+        time.sleep(0.2)
+        if time.time() - t0 > timeout_s:
+            sup.kill()
+            raise AssertionError(f"[fleet] timed out after {timeout_s}s")
+        if killed or not status_path.exists():
+            continue
+        try:
+            st = json.loads(status_path.read_text())
+        except ValueError:
+            continue
+        if st.get("status") == "serving" \
+                and kill_at <= st.get("round", 0) <= rounds - 4:
+            killed = sigterm_child(state, 1)
+            if killed:
+                print(f"[fleet] SIGTERM follower at round {st['round']} "
+                      "-> rolling restart", flush=True)
+    rc = sup.wait()
+    assert rc == 0, f"[fleet] supervisor exited rc={rc} " \
+                    f"(logs in {state / 'logs'})"
+    assert killed, f"[fleet] never caught the fleet inside the " \
+                   f"SIGTERM window (>= {kill_at})"
+    final = json.loads((state / "final.json").read_text())
+    assert final["round"] == rounds and final.get("restarts", 0) >= 1, \
+        {k: final.get(k) for k in ("round", "restarts")}
+    rep = final.get("report") or {}
+    assert rep.get("verdict") in ("healthy", "warn"), rep
+    assert rep.get("alerts", 0) >= 1, \
+        "sensitized drop_rate rule never fired — alert_latency " \
+        "unmeasured"
+
+    # Cross-process DETERMINISTIC_KINDS consistency through the
+    # rolling restart, via the product's own aggregator CLI.
+    merged_path = state / "merged.jsonl"
+    rc = subprocess.run(
+        [sys.executable, "-m", "dopt.obs.aggregate",
+         "--state-dir", str(state), "--processes", "2",
+         "--merged-out", str(merged_path)], cwd=REPO).returncode
+    assert rc == 0, "fleet aggregator found cross-process divergence"
+    rc = subprocess.run(
+        [sys.executable, "-m", "dopt.obs.check", str(merged_path),
+         "--state-dir", str(state)], cwd=REPO).returncode
+    assert rc == 0, "merged / per-process streams failed dopt.obs.check"
+    print("[fleet] aggregator consistency + merged stream check ok",
+          flush=True)
+
+    merged = JsonlSink.read(merged_path)
+    procs = {e.get("process") for e in merged if e.get("kind") == "latency"}
+    assert procs == {0, 1}, \
+        f"expected latency events from both processes, saw {procs}"
+    slo = summarize_latency_events(merged)
+    assert_slo(slo, SLO_CORE + ("alert_latency",))
+    payload = {"mode": "fleet", "engine": args.engine, "rounds": rounds,
+               "restarts": final.get("restarts"),
+               "alerts": rep.get("alerts"), "verdict": rep.get("verdict"),
+               "elapsed_s": round(time.time() - t0, 1), "slo": slo,
+               "final_slo": final.get("slo")}
+    if args.slo_out:
+        write_slo_report(args.slo_out, payload)
+    print("fleet soak passed: 2-process fleet with rolling restart, "
+          "cross-process deterministic consistency verified, merged "
+          "stream checked, SLO p50/p99 finite for "
+          f"{', '.join(SLO_CORE + ('alert_latency',))}", flush=True)
+    return 0
+
+
+def run_long_soak(args, root: Path) -> int:
+    """``--minutes N``: the ROADMAP long soak.  One resident daemon
+    kept alive for N wall minutes under seeded randomized churn —
+    membership leave/join, lr + checkpoint-cadence config churn,
+    SIGTERM rolling restarts — then drained; the SLO latency report
+    (p50/p99 per name) is the artifact."""
+    import random
+
+    from dopt.obs import JsonlSink, check_stream, summarize_latency_events
+    from dopt.serve.control import CommandQueue, make_command
+    from dopt.utils.metrics import atomic_write_text
+
+    rng = random.Random(args.seed)
+    state = root / "long"
+    if state.exists():
+        import shutil
+
+        shutil.rmtree(state)
+    state.mkdir(parents=True)
+    rules_file = root / "long-rules.json"
+    atomic_write_text(rules_file, json.dumps(SENSITIZED_RULES))
+    cmd = [sys.executable, "-m", "dopt.serve",
+           *serve_args(args.engine, 10**9, args.seed,
+                       args.checkpoint_every),
+           "--state-dir", str(state), "--rules-file", str(rules_file),
+           "--on-term", "restart", "--no-admin"]
+    # serve_args pins --max-rounds; strip it — the long soak runs on
+    # wall time and drains through the control plane.
+    i = cmd.index("--max-rounds")
+    del cmd[i:i + 2]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    deadline = time.time() + args.minutes * 60.0
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO)
+    q = CommandQueue(state / "commands.jsonl")
+    away: set[int] = set()
+    n_cmd = n_restart = 0
+    next_cmd = time.time() + args.churn_period
+    next_restart = time.time() + max(args.churn_period * 3, 30.0)
+    status_path = state / "serve.json"
+    print(f"[long] {args.minutes:.1f} min of randomized churn "
+          f"(seed {args.seed}, command every ~{args.churn_period:.0f}s)",
+          flush=True)
+    try:
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"[long] daemon exited rc={proc.returncode} "
+                    "mid-soak")
+            time.sleep(1.0)
+            now = time.time()
+            if now >= next_cmd:
+                next_cmd = now + args.churn_period * (0.5 + rng.random())
+                kind = rng.choice(("membership", "lr", "cadence",
+                                   "checkpoint"))
+                n_cmd += 1
+                cid = f"churn-{n_cmd}"
+                if kind == "membership":
+                    if away and (len(away) >= 3 or rng.random() < 0.5):
+                        w = rng.choice(sorted(away))
+                        away.discard(w)
+                        q.submit(make_command("membership", worker=w,
+                                              action="join", id=cid))
+                    else:
+                        w = rng.choice([i for i in range(1, 8)
+                                        if i not in away])
+                        away.add(w)
+                        q.submit(make_command("membership", worker=w,
+                                              action="leave", id=cid))
+                elif kind == "lr":
+                    q.submit(make_command(
+                        "config", key="optim.lr",
+                        value=round(0.05 + 0.1 * rng.random(), 4),
+                        id=cid))
+                elif kind == "cadence":
+                    q.submit(make_command(
+                        "config", key="checkpoint_every",
+                        value=rng.choice((4, 8, 12)), id=cid))
+                else:
+                    q.submit(make_command("checkpoint", id=cid))
+            if now >= next_restart and status_path.exists() \
+                    and deadline - now > 45.0:
+                # Leave headroom before the drain: a SIGTERM racing the
+                # deadline would lose its boundary to the drain command
+                # and count a restart that never happened.
+                next_restart = now + max(args.churn_period * 3, 30.0)
+                try:
+                    st = json.loads(status_path.read_text())
+                except ValueError:
+                    continue
+                if st.get("status") == "serving" and st.get("pid"):
+                    n_restart += 1
+                    print(f"[long] SIGTERM at round {st.get('round')} "
+                          f"(restart {n_restart})", flush=True)
+                    try:
+                        os.kill(int(st["pid"]), signal.SIGTERM)
+                    except OSError:
+                        pass
+        q.submit(make_command("drain", id="long-drain"))
+        rc = proc.wait(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == 0, f"[long] daemon exited rc={rc}"
+    elapsed = time.time() - t0
+    final = json.loads((state / "final.json").read_text())
+    rep = final.get("report") or {}
+    assert rep.get("verdict") in ("healthy", "warn"), rep
+    events = JsonlSink.read(state / "metrics.jsonl")
+    summary = check_stream(events)
+    print(f"[long] drained at round {final['round']} after "
+          f"{elapsed / 60:.1f} min: {n_cmd} commands, {n_restart} "
+          f"SIGTERM restarts (survived {final.get('restarts')}), "
+          f"{summary['segments']} stream segments, verdict "
+          f"{rep.get('verdict')}", flush=True)
+    assert final.get("restarts", 0) >= min(n_restart, 1), final.get(
+        "restarts")
+    slo = summarize_latency_events(events)
+    core = [n for n in SLO_CORE
+            if n != "checkpoint_restore" or n_restart > 0
+            or "checkpoint_restore" in slo]
+    assert_slo(slo, core)
+    if rep.get("alerts", 0) >= 1:
+        assert_slo(slo, ("alert_latency",))
+    payload = {"mode": "long", "engine": args.engine,
+               "minutes": args.minutes, "rounds": final["round"],
+               "commands": n_cmd, "sigterm_restarts": n_restart,
+               "restarts": final.get("restarts"),
+               "alerts": rep.get("alerts"),
+               "verdict": rep.get("verdict"),
+               "segments": summary["segments"],
+               "elapsed_s": round(elapsed, 1), "slo": slo,
+               "final_slo": final.get("slo")}
+    if args.slo_out:
+        write_slo_report(args.slo_out, payload)
+    print("long soak passed: resident through randomized live + config "
+          "churn, stream integrity intact, SLO latencies measured",
+          flush=True)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rounds", type=int, default=48)
@@ -179,6 +507,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--min-seconds", type=float, default=0.0,
                     help="assert the restarted leg stayed resident at "
                          "least this long (the ROADMAP's >=60s soak bar)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the 2-process fleet leg instead: rolling "
+                         "restart + aggregator consistency + merged-"
+                         "stream check + SLO report")
+    ap.add_argument("--minutes", type=float, default=None, metavar="N",
+                    help="LONG-soak mode: keep one resident run alive "
+                         "for N wall minutes under seeded randomized "
+                         "live churn + config churn, then drain and "
+                         "report SLO latencies (the ROADMAP 1-hour "
+                         "soak is --minutes 60)")
+    ap.add_argument("--churn-period", type=float, default=20.0,
+                    help="long-soak mean seconds between randomized "
+                         "commands (restarts fire every ~4 periods)")
+    ap.add_argument("--slo-out", default=None, metavar="PATH",
+                    help="write the SLO latency report (p50/p99 per "
+                         "latency name) here (fleet/long modes)")
     ap.add_argument("--state-root", default=None,
                     help="scratch root (default: a temp dir)")
     ap.add_argument("--report-out", default=None, metavar="PATH",
@@ -193,6 +537,10 @@ def main(argv: list[str] | None = None) -> int:
     # harness and the daemon.
     root = Path(args.state_root
                 or tempfile.mkdtemp(prefix="dopt-soak-")).resolve()
+    if args.minutes is not None:
+        return run_long_soak(args, root)
+    if args.fleet:
+        return run_fleet_soak(args, root)
     rounds = args.rounds
     attempt = 0
     dir_a = root / "uninterrupted"

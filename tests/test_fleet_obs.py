@@ -1,0 +1,664 @@
+"""Fleet-scope observability (PR 15): cross-process aggregation, SLO
+latency histograms, on-demand profiling, and the stream differ.
+
+Synthetic-stream tests cover the histogram math (bucket boundaries,
+JSON state round-trip, Prometheus histogram exposition), the
+``latency`` event schema, the monitor's latency/lag accounting, the
+``FleetAggregator`` (merge ordering on the round watermark,
+cross-process divergence detection with both events reported,
+per-process torn-tail tolerance), the fleet endpoint's 503 contract
+(Retry-After + JSON body) and ``dopt.obs.diff``.  One real-engine test
+pins the profiling guarantee: arming ``/admin/profile`` mid-run writes
+a loadable Chrome trace while History, fault ledger and canonical
+stream stay bit-identical to an unprofiled run.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from dopt.obs import (HealthMonitor, JsonlSink, LatencyHistogram,
+                      PrometheusSink, make_event, summarize_latency_events,
+                      validate_event)
+from dopt.obs.aggregate import (FleetAggregator, FleetMetricsServer,
+                                fleet_metric_paths)
+from dopt.obs.diff import first_divergence
+from dopt.obs.diff import main as diff_main
+from dopt.obs.latency import DEFAULT_BUCKETS
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------- histogram math
+
+def test_histogram_bucket_boundaries_and_counts():
+    h = LatencyHistogram(bounds=(0.01, 0.1, 1.0))
+    for v in (0.0, 0.01, 0.05, 0.5, 2.0):
+        h.observe(v)
+    # 0.0 and 0.01 land in (0, 0.01]; 0.05 in (0.01, 0.1]; 0.5 in
+    # (0.1, 1.0]; 2.0 overflows to +Inf.
+    assert h.counts == [2, 1, 1, 1]
+    assert h.count == 5 and h.min == 0.0 and h.max == 2.0
+    with pytest.raises(ValueError, match="finite"):
+        h.observe(-1.0)
+    with pytest.raises(ValueError, match="increasing"):
+        LatencyHistogram(bounds=(1.0, 1.0))
+
+
+def test_histogram_quantiles_and_summary():
+    h = LatencyHistogram()
+    for _ in range(99):
+        h.observe(0.02)
+    h.observe(50.0)
+    s = h.summary()
+    assert s["count"] == 100
+    assert 0.01 <= s["p50"] <= 0.025       # inside 0.02's bucket
+    # The 99th of 100 samples is still the 0.02 mass; only past it
+    # does the estimate jump into the outlier's bucket.
+    assert s["p99"] <= 0.025
+    assert h.quantile(0.999) > 1.0
+    assert s["min"] == 0.02 and s["max"] == 50.0
+    assert LatencyHistogram().summary()["p50"] is None
+
+
+def test_histogram_state_json_round_trip_and_merge():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for v in (0.001, 0.3, 7.0):
+        a.observe(v)
+    b.observe(0.3)
+    st = json.loads(json.dumps(a.state()))
+    a2 = LatencyHistogram.from_state(st)
+    assert a2.counts == a.counts and a2.summary() == a.summary()
+    a2.merge(b)
+    assert a2.count == 4 and a2.min == 0.001 and a2.max == 7.0
+    with pytest.raises(ValueError, match="bounds"):
+        a2.merge(LatencyHistogram(bounds=(1.0, 2.0)))
+
+
+def test_prometheus_histogram_exposition():
+    p = PrometheusSink()
+    for secs in (0.002, 0.002, 5.0):
+        p.emit(make_event("latency", round=1, name="boundary_tick",
+                          seconds=secs))
+    out = p.render()
+    assert "# TYPE dopt_latency_seconds histogram" in out
+    # Cumulative le buckets, then the exact +Inf/sum/count triplet.
+    assert ('dopt_latency_seconds_bucket{name="boundary_tick",'
+            'le="+Inf"} 3') in out
+    assert 'dopt_latency_seconds_count{name="boundary_tick"} 3' in out
+    assert 'dopt_latency_seconds_sum{name="boundary_tick"}' in out
+    # Cumulative counts never decrease across the le series.
+    counts = [int(line.rsplit(" ", 1)[1]) for line in out.splitlines()
+              if line.startswith("dopt_latency_seconds_bucket")]
+    assert counts == sorted(counts)
+    assert len(counts) == len(DEFAULT_BUCKETS) + 1
+
+
+def test_latency_event_schema():
+    validate_event(make_event("latency", round=0, name="checkpoint_save",
+                              seconds=0.25))
+    with pytest.raises(ValueError, match="seconds"):
+        validate_event(make_event("latency", round=0, name="x",
+                                  seconds=float("nan")))
+    with pytest.raises(ValueError, match="name"):
+        validate_event({"v": 1, "kind": "latency", "ts": 1.0, "round": 0,
+                        "seconds": 0.1})
+
+
+def test_summarize_latency_events_skips_garbage():
+    evs = [make_event("latency", round=0, name="a", seconds=0.1),
+           make_event("round", round=0, engine="g", metrics={}),
+           {"kind": "latency", "name": "a", "seconds": "nope"},
+           make_event("latency", round=1, name="a", seconds=0.3)]
+    s = summarize_latency_events(evs)
+    assert s["a"]["count"] == 2 and s["a"]["max"] == 0.3
+
+
+# ------------------------------------------- monitor latency + lag
+
+def _round_ev(t, loss=1.0):
+    return make_event("round", round=t, engine="gossip",
+                      metrics={"avg_train_loss": loss})
+
+
+def test_monitor_accumulates_latency_and_reports():
+    mon = HealthMonitor()
+    mon.feed([make_event("run", engine="gossip", name="t", round=0),
+              _round_ev(0),
+              make_event("latency", round=0, name="boundary_tick",
+                         seconds=0.01),
+              make_event("latency", round=0, name="boundary_tick",
+                         seconds=0.02)])
+    rep = mon.report()
+    assert rep.latency["boundary_tick"]["count"] == 2
+    assert rep.latency["boundary_tick"]["p50"] is not None
+    assert mon.lag_seconds() is not None and mon.lag_seconds() < 120
+    # State round-trips the histograms AND the staleness meters.
+    st = json.loads(json.dumps(mon.state()))
+    mon2 = HealthMonitor(state=st)
+    assert mon2.report().latency == rep.latency
+    assert mon2.last_event_ts == mon.last_event_ts
+    assert HealthMonitor().lag_seconds() is None
+
+
+def test_monitor_measures_alert_latency_on_fire():
+    # loss_nonfinite fires when the loss goes null after a finite one;
+    # an ATTACHED (live fan-out) monitor self-observes the alert's
+    # latency vs the triggering round bundle's ts and forwards the
+    # latency event to the other sinks.
+    from dopt.obs import MemorySink, Telemetry
+
+    mem = MemorySink()
+    mon = HealthMonitor().attach(Telemetry([mem]))
+    mon.feed([make_event("run", engine="gossip", name="t", round=0),
+              _round_ev(0, loss=1.0)])
+    fired = mon.feed([_round_ev(1, loss=None)])
+    assert [a["rule"] for a in fired] == ["loss_nonfinite"]
+    s = mon.report().latency["alert_latency"]
+    assert s["count"] == 1 and 0.0 <= s["max"] < 60.0
+    assert mem.events[-1]["name"] == "alert_latency"
+    # A tail/replay-fed monitor (no telemetry) must NOT self-measure:
+    # "alert now minus round then" would report poll cadence, not
+    # alert latency (it still folds embedded latency events).
+    cold = HealthMonitor()
+    cold.feed([make_event("run", engine="gossip", name="t", round=0),
+               _round_ev(0, loss=1.0)])
+    cold.feed([_round_ev(1, loss=None)])
+    assert "alert_latency" not in cold.report().latency
+
+
+# --------------------------------------------------- fleet aggregation
+
+def _bundle(t, *, lanes=8.0, latency=None, engine="gossip"):
+    evs = [make_event("gauge", round=t, name="participating_lanes",
+                      value=lanes, engine=engine),
+           make_event("round", round=t, engine=engine,
+                      metrics={"avg_train_loss": 1.0 - 0.01 * t})]
+    if latency is not None:
+        evs.append(make_event("latency", round=t, name="boundary_tick",
+                              seconds=latency))
+    return evs
+
+
+def _write_stream(path: Path, events) -> None:
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+
+def _fleet_dir(tmp_path, rounds=5, mutate=None):
+    hdr = make_event("run", engine="gossip", name="t", round=0, workers=8)
+    a = [hdr] + [e for t in range(rounds)
+                 for e in _bundle(t, latency=0.01)]
+    b = [hdr] + [e for t in range(rounds)
+                 for e in _bundle(t, latency=0.03)]
+    if mutate is not None:
+        mutate(b)
+    _write_stream(tmp_path / "metrics.jsonl", a)
+    _write_stream(tmp_path / "metrics-p1.jsonl", b)
+    return tmp_path
+
+
+def test_fleet_paths_discovery(tmp_path):
+    (tmp_path / "metrics.jsonl").write_text("")
+    (tmp_path / "metrics-p1.jsonl").write_text("")
+    (tmp_path / "metrics-p2.jsonl").write_text("")
+    assert sorted(fleet_metric_paths(tmp_path)) == [0, 1, 2]
+    expect = fleet_metric_paths(tmp_path, 4)
+    assert sorted(expect) == [0, 1, 2, 3]   # expected, not yet existing
+
+
+def test_aggregator_merges_and_stamps_provenance(tmp_path):
+    from dopt.obs import check_stream
+
+    _fleet_dir(tmp_path)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    agg.flush_trailing()
+    assert agg.divergence is None and agg.rounds_merged == 5
+    summary = check_stream(agg.merged)
+    assert summary["rounds"] == 5
+    lat = [e for e in agg.merged if e["kind"] == "latency"]
+    assert {e["process"] for e in lat} == {0, 1}
+    # Deterministic events appear ONCE (the leader's copy).
+    rounds = [e for e in agg.merged if e["kind"] == "round"]
+    assert len(rounds) == 5 and all(e["process"] == 0 for e in rounds)
+
+
+def test_aggregator_holds_merge_at_min_watermark(tmp_path):
+    hdr = make_event("run", engine="gossip", name="t", round=0, workers=8)
+    a = [hdr] + [e for t in range(6) for e in _bundle(t)]
+    b = [hdr] + [e for t in range(2) for e in _bundle(t)]   # p1 behind
+    _write_stream(tmp_path / "metrics.jsonl", a)
+    _write_stream(tmp_path / "metrics-p1.jsonl", b)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    assert agg.rounds_merged == 2          # never past p1's watermark
+    st = agg.stats()
+    assert st["processes"][0]["sealed_ahead"] == 4
+    assert st["fleet_round"] == 1
+    # p1 catches up: the merge resumes without reprocessing.
+    with open(tmp_path / "metrics-p1.jsonl", "a") as f:
+        for t in range(2, 6):
+            for e in _bundle(t):
+                f.write(json.dumps(e) + "\n")
+    agg.poll()
+    assert agg.rounds_merged == 6 and agg.divergence is None
+
+
+def test_aggregator_reports_first_divergence_with_both_events(tmp_path):
+    def mutate(b):
+        for e in b:
+            if e["kind"] == "gauge" and e.get("round") == 3:
+                e["value"] = 7.0
+    _fleet_dir(tmp_path, mutate=mutate)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    d = agg.divergence
+    assert d is not None and d["round"] == 3 and d["process"] == 1
+    assert d["leader"]["value"] == 8.0 and d["other"]["value"] == 7.0
+    assert agg.rounds_merged == 3          # merge stopped at the fault
+    # Strict mode raises with the same record.
+    from dopt.obs.aggregate import FleetDivergenceError
+
+    agg2 = FleetAggregator(tmp_path, num_processes=2, strict=True)
+    with pytest.raises(FleetDivergenceError) as ei:
+        agg2.poll()
+    assert ei.value.record["round"] == 3
+
+
+def test_aggregator_divergence_on_round_sequence_skew(tmp_path):
+    def mutate(b):
+        # p1 skips round 2 entirely: its round sequence diverges.
+        b[:] = [e for e in b if e.get("round") != 2]
+    _fleet_dir(tmp_path, mutate=mutate)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    d = agg.divergence
+    assert d is not None and "round sequence mismatch" in d["reason"]
+
+
+def test_aggregator_tolerates_torn_tail_per_process(tmp_path):
+    _fleet_dir(tmp_path)
+    # Tear p1's final line mid-write: the tail holds, no divergence.
+    raw = (tmp_path / "metrics-p1.jsonl").read_text().splitlines()
+    (tmp_path / "metrics-p1.jsonl").write_text(
+        "\n".join(raw[:-1]) + "\n" + raw[-1][:17])
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    assert agg.divergence is None
+    assert agg.rounds_merged == 5          # p1's last latency line torn
+    # The writer finishes the line: consumed on the next poll.
+    with open(tmp_path / "metrics-p1.jsonl", "a") as f:
+        f.write(raw[-1][17:] + "\n")
+    agg.poll()
+    agg.flush_trailing()
+    assert agg.divergence is None
+
+
+def test_aggregator_clears_pending_on_file_shrink(tmp_path):
+    """repair_tail on a resumed daemon SHRINKS a stream (orphans of an
+    unsealed bundle dropped); the aggregator must drop its own pending
+    copy of those orphans or the re-emitted bundle double-counts."""
+    _fleet_dir(tmp_path, rounds=3)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    # p1 appends an orphan gauge (bundle never sealed)...
+    orphan = make_event("gauge", round=3, name="participating_lanes",
+                        value=8.0, engine="gossip")
+    with open(tmp_path / "metrics-p1.jsonl", "a") as f:
+        f.write(json.dumps(orphan) + "\n")
+    agg.poll()
+    # ...then "repair_tail" removes it and the resumed daemon re-emits
+    # the whole bundle.
+    raw = (tmp_path / "metrics-p1.jsonl").read_text().splitlines()
+    (tmp_path / "metrics-p1.jsonl").write_text(
+        "\n".join(raw[:-1]) + "\n")
+    with open(tmp_path / "metrics-p1.jsonl", "a") as f:
+        for e in _bundle(3):
+            f.write(json.dumps(e) + "\n")
+    with open(tmp_path / "metrics.jsonl", "a") as f:
+        for e in _bundle(3):
+            f.write(json.dumps(e) + "\n")
+    agg.poll()
+    assert agg.divergence is None, agg.divergence
+    assert agg.rounds_merged == 4
+
+
+def test_aggregator_resyncs_on_shrink_then_regrow(tmp_path):
+    """repair_tail truncates a stream and the resumed daemon appends
+    PAST the old byte offset before the next poll: size alone cannot
+    see it, but the guard bytes changed — the aggregator must resync
+    from byte 0 (skipping fleet-sealed rounds) instead of reading from
+    mid-line and poisoning the merge with a ValueError."""
+    _fleet_dir(tmp_path, rounds=3)
+    agg = FleetAggregator(tmp_path, num_processes=2)
+    agg.poll()
+    assert agg.rounds_merged == 3
+    # p1's tail is rewritten: drop its last bundle entirely, then
+    # re-emit it plus two more rounds — by the next poll the file is
+    # LONGER than the old offset.
+    lines = (tmp_path / "metrics-p1.jsonl").read_text().splitlines()
+    keep = lines[:-3]   # drop round 2's bundle (gauge+round+latency)
+    regrown = keep + [json.dumps(e) for t in (2, 3, 4)
+                      for e in _bundle(t, latency=0.05)]
+    (tmp_path / "metrics-p1.jsonl").write_text(
+        "\n".join(regrown) + "\n")
+    with open(tmp_path / "metrics.jsonl", "a") as f:
+        for t in (3, 4):
+            for e in _bundle(t, latency=0.01):
+                f.write(json.dumps(e) + "\n")
+    agg.poll()
+    agg.flush_trailing()
+    assert agg.divergence is None, agg.divergence
+    assert agg.rounds_merged == 5
+    # Round 2 was fleet-sealed before the rewrite: its replayed copy
+    # must not re-merge (no duplicate round events).
+    rounds = [e["round"] for e in agg.merged if e["kind"] == "round"]
+    assert rounds == [0, 1, 2, 3, 4]
+
+
+def test_aggregator_cli_json(tmp_path, capsys):
+    from dopt.obs.aggregate import main as agg_main
+
+    _fleet_dir(tmp_path)
+    merged = tmp_path / "merged.jsonl"
+    rc = agg_main(["--state-dir", str(tmp_path), "--processes", "2",
+                   "--merged-out", str(merged), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["ok"] and report["divergence"] is None
+    assert report["merged_check"]["rounds"] == 5
+    assert merged.exists()
+    evs = JsonlSink.read(merged)
+    assert {e.get("process") for e in evs} == {0, 1}
+
+
+def test_fleet_metrics_server_healthz_and_retry_after(tmp_path):
+    def mutate(b):
+        for e in b:
+            if e["kind"] == "round" and e.get("round") == 4:
+                e["metrics"] = {"avg_train_loss": 0.5}
+    _fleet_dir(tmp_path, mutate=mutate)
+    server = FleetMetricsServer(tmp_path, num_processes=2).start()
+    try:
+        port = server.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        assert "dopt_fleet_processes 2" in body
+        assert "dopt_fleet_divergent 1" in body
+        assert 'dopt_latency_seconds_bucket{name="boundary_tick"' in body
+        # Diverged fleet: /healthz is 503 with Retry-After + JSON body.
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("Retry-After") is not None
+            payload = json.loads(e.read())
+        assert payload["fleet"]["divergence"]["round"] == 4
+        assert "lag_seconds" in payload
+    finally:
+        server.shutdown()
+
+
+# --------------------------------------------------------- stream diff
+
+def test_diff_identical_and_seeded_divergence(tmp_path, capsys):
+    hdr = make_event("run", engine="gossip", name="t", round=0)
+    evs = [hdr] + [e for t in range(4) for e in _bundle(t)]
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write_stream(a, evs)
+    _write_stream(b, evs)
+    assert diff_main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "identical" in out
+    # Seeded mutation: flip one round metric — diff reports exactly it.
+    mut = [json.loads(json.dumps(e)) for e in evs]
+    for e in mut:
+        if e["kind"] == "round" and e["round"] == 2:
+            e["metrics"]["avg_train_loss"] = 9.9
+    _write_stream(b, mut)
+    assert diff_main([str(a), str(b), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    d = report["divergence"]
+    assert d["kind"] == "round" and d["round"] == 2
+    assert d["a"]["metrics"]["avg_train_loss"] != \
+        d["b"]["metrics"]["avg_train_loss"]
+    # Prefix streams: the longer side is named.
+    _write_stream(b, evs[:-2])
+    assert diff_main([str(a), str(b)]) == 1
+    assert first_divergence(evs, evs[:-2])["reason"].startswith(
+        "stream b ends")
+
+
+def test_diff_kinds_filter(tmp_path):
+    evs = [_round_ev(0),
+           make_event("latency", round=0, name="x", seconds=0.1)]
+    other = [_round_ev(0),
+             make_event("latency", round=0, name="x", seconds=0.9)]
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write_stream(a, evs)
+    _write_stream(b, other)
+    # Latency differs but is non-deterministic: default diff passes...
+    assert diff_main([str(a), str(b)]) == 0
+    # ...and --all-kinds sees it.
+    assert diff_main([str(a), str(b), "--all-kinds"]) == 1
+
+
+# --------------------------------------------------- check / watch / serve
+
+def test_check_state_dir_glob(tmp_path, capsys):
+    from dopt.obs.check import main as check_main
+
+    fleet = tmp_path / "run"
+    fleet.mkdir()
+    _write_stream(fleet / "metrics.jsonl",
+                  [make_event("run", engine="g", name="t", round=0),
+                   _round_ev(0)])
+    _write_stream(fleet / "metrics-p1.jsonl",
+                  [make_event("run", engine="g", name="t", round=0),
+                   _round_ev(0)])
+    assert check_main(["--state-dir", str(fleet), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["checked"] == 2 and report["clean"]
+    # One corrupt stream fails the whole invocation (shared exit code).
+    (fleet / "metrics-p1.jsonl").write_text("not json\nstill not\n")
+    assert check_main(["--state-dir", str(fleet), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    oks = {f["path"]: f["ok"] for f in report["files"]}
+    assert oks[str(fleet / "metrics.jsonl")] is True
+    assert oks[str(fleet / "metrics-p1.jsonl")] is False
+    assert check_main(["--state-dir", str(tmp_path / "empty")]) == 1
+
+
+def test_obs_serve_healthz_lag_and_retry_after(tmp_path):
+    from dopt.obs.serve import MetricsServer
+
+    metrics = tmp_path / "metrics.jsonl"
+    _write_stream(metrics,
+                  [make_event("run", engine="g", name="t", round=0),
+                   _round_ev(0, loss=1.0), _round_ev(1, loss=None)])
+    server = MetricsServer(metrics, port=0).start()
+    try:
+        # loss going null after a finite value = loss_nonfinite
+        # critical -> 503 now carries Retry-After + the lag fields.
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=10)
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("Retry-After") is not None
+            body = json.loads(e.read())
+        assert body["verdict"] == "critical"
+        assert isinstance(body["lag_seconds"], float)
+        assert body["last_event_ts"] is not None
+    finally:
+        server.shutdown()
+
+
+def test_watch_fleet_renders_processes_and_alert_provenance(tmp_path):
+    from dopt.obs.watch import FleetWatchState
+
+    def mutate(b):
+        b.append(make_event("alert", round=4, rule="drop_rate",
+                            severity="warn", message="x"))
+    _fleet_dir(tmp_path, mutate=mutate)
+    (tmp_path / "serve.json").write_text(json.dumps(
+        {"status": "serving", "admin_port": 12345}))
+    watch = FleetWatchState(str(tmp_path), processes=2)
+    watch.poll()
+    out = watch.render()
+    assert "p0" in out and "p1" in out
+    assert "admin :12345" in out
+    assert "consistency ok" in out
+    assert "ALERT [warn] p1 drop_rate @ round 4" in out
+    assert not watch.critical()
+
+
+# ----------------------------------------------- command-queue ts stamp
+
+def test_command_queue_stamps_enqueue_ts(tmp_path):
+    from dopt.serve.control import (CommandQueue, make_command,
+                                    validate_command)
+
+    q = CommandQueue(tmp_path / "commands.jsonl")
+    cmd = q.submit(make_command("checkpoint", id="c1"))
+    assert isinstance(cmd["ts"], float) and cmd["ts"] > 0
+    cmds, rejects = q.poll()
+    assert cmds[0]["ts"] == cmd["ts"] and not rejects
+    with pytest.raises(ValueError, match="ts"):
+        validate_command({"v": 1, "cmd": "checkpoint", "ts": -3})
+    # Pre-stamped commands keep their own stamp (replayed scripts).
+    cmd2 = q.submit({"v": 1, "cmd": "checkpoint", "id": "c2", "ts": 5.0})
+    assert cmd2["ts"] == 5.0
+
+
+# --------------------------------------- admin profile endpoint wiring
+
+def test_admin_profile_endpoint_wiring():
+    from dopt.serve.admin import AdminServer
+
+    calls = {}
+
+    def request_profile(rounds):
+        if rounds == 0:
+            raise ValueError("profile rounds must be in [1, 10000]")
+        calls["rounds"] = rounds
+        return {"pending_rounds": rounds, "active": None,
+                "artifacts": []}
+
+    daemon = SimpleNamespace(request_profile=request_profile,
+                             profile_status=lambda: {
+                                 "pending_rounds": 0, "active": None,
+                                 "artifacts": ["x.trace.json"]})
+    srv = AdminServer(daemon, port=0)
+    try:
+        code, body = srv._post("/admin/profile", {"rounds": 3})
+        assert code == 202 and json.loads(body)["pending_rounds"] == 3
+        assert calls["rounds"] == 3
+        code, body = srv._post("/admin/profile", {"rounds": 0})
+        assert code == 400 and "error" in json.loads(body)
+        code, body, _ = srv._get("/admin/profile")
+        assert code == 200
+        assert json.loads(body)["artifacts"] == ["x.trace.json"]
+    finally:
+        srv._httpd.server_close()
+
+
+# ------------------------------------ real-engine: profiling + latency
+
+def _tiny_cfg(rounds=4):
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+
+    return ExperimentConfig(
+        name="fleet-obs-test", seed=7,
+        data=DataConfig(dataset="synthetic", num_users=8, iid=True,
+                        synthetic_train_size=256, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=rounds, local_ep=1,
+                            local_bs=32))
+
+
+def test_profile_bit_identity_and_slo_latencies(tmp_path):
+    """The tentpole acceptance pin, in-process: a served run with
+    profiling armed mid-run writes a loadable Chrome trace (device
+    events + host spans) while History, fault ledger and canonical
+    stream stay bit-identical to an unprofiled run; both runs stream
+    the SLO latency channel and the drain artifact summarizes it."""
+    from dopt.obs import canonical, check_stream
+    from dopt.serve import CommandQueue, ServeDaemon, make_command
+
+    def leg(name, profile):
+        d = tmp_path / name
+        CommandQueue(d / "commands.jsonl").submit(
+            make_command("config", key="optim.lr", value=0.05,
+                         at_round=2, id="lr"))
+        daemon = ServeDaemon(_tiny_cfg(), d, checkpoint_every=2,
+                             max_rounds=4, admin_port=None).start()
+        if profile:
+            daemon.request_profile(2)
+        assert daemon.serve() == 0
+        return daemon, JsonlSink.read(d / "metrics.jsonl"), \
+            json.loads((d / "final.json").read_text())
+
+    da, ev_a, final_a = leg("plain", profile=False)
+    db, ev_b, final_b = leg("profiled", profile=True)
+
+    # Bit-identity: profiling must not perturb anything deterministic.
+    assert canonical(ev_a) == canonical(ev_b)
+    assert db.trainer.history.rows == da.trainer.history.rows
+    assert db.trainer.history.faults == da.trainer.history.faults
+    check_stream(ev_a)
+    check_stream(ev_b)
+
+    # SLO latency channel: events in the stream, summary in final.json.
+    names = {e["name"] for e in ev_a if e["kind"] == "latency"}
+    assert {"boundary_tick", "command_apply", "checkpoint_save",
+            "checkpoint_restore"} <= names, names
+    for key in ("boundary_tick", "command_apply", "checkpoint_save"):
+        s = final_a["slo"][key]
+        assert s["count"] >= 1 and isinstance(s["p50"], float)
+        assert isinstance(s["p99"], float)
+
+    # The profile artifact: one loadable Chrome trace, device events
+    # merged with the host span track.
+    assert len(final_b["profiles"]) == 1
+    trace = json.loads(Path(final_b["profiles"][0]).read_text())
+    events = trace["traceEvents"]
+    assert len(events) > 0
+    assert any(e.get("pid") == 900_000 for e in events), \
+        "host spans missing from the merged trace"
+    assert final_a["profiles"] == []
+    # Double-arming is refused.
+    db2 = ServeDaemon(_tiny_cfg(), tmp_path / "plain2",
+                      admin_port=None)
+    db2._profile_pending = 3
+    with pytest.raises(ValueError, match="already armed"):
+        db2.request_profile(1)
+
+
+def test_follower_stream_naming_and_rules_file(tmp_path):
+    from dopt.serve import ServeDaemon, serve_rules
+
+    d = ServeDaemon(_tiny_cfg(), tmp_path, process_id=1,
+                    num_processes=2, admin_port=None)
+    assert d.metrics_path.name == "metrics-p1.jsonl"
+    assert not d.is_leader
+    d0 = ServeDaemon(_tiny_cfg(), tmp_path, admin_port=None)
+    assert d0.metrics_path.name == "metrics.jsonl"
+    # serve_rules(specs=...) replaces the stock set but ALWAYS appends
+    # the escalated auto-pause rule.
+    rules = serve_rules(specs=[{"rule": "drop_rate", "max_rate": 0.02,
+                                "window": 4, "min_rounds": 2}])
+    assert [r.name for r in rules] == ["drop_rate", "drop_rate_critical"]
+    assert rules[-1].severity == "critical"

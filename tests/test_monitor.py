@@ -300,7 +300,10 @@ def test_monitor_attach_forwards_alerts_in_stream_order():
     tele.emit_round_bundle(6, engine="fed",
                            metrics={"train_loss": 500.0})
     kinds = [e["kind"] for e in mem.events]
-    assert kinds[-1] == "alert" and kinds[-2] == "round"
+    # The alert lands just after its triggering round, trailed by its
+    # measured alert_latency observation (the SLO latency channel).
+    assert kinds[-3:] == ["round", "alert", "latency"]
+    assert mem.events[-1]["name"] == "alert_latency"
     assert mon.alerts and mon.alerts[0]["rule"] == "loss_divergence"
 
 
