@@ -211,6 +211,90 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
     }
 
 
+def _topology_config(*, topology: str, mixing: str, train_size: int,
+                     test_size: int, workers: int = 32,
+                     prefetch: str = "off"):
+    """The round-r06 mixing-pattern ablation workload: 32 worker lanes
+    (folded onto however many devices exist), MLP on synthetic data,
+    ONE local epoch of light steps — communication-dominated by
+    construction, so the topology/mixing delta is what the wall
+    measures rather than the conv stack."""
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+
+    return ExperimentConfig(
+        name=f"bench-topo-{topology}-{mixing}",
+        seed=2028,
+        data=DataConfig(dataset="synthetic", num_users=workers, iid=True,
+                        synthetic_train_size=train_size,
+                        synthetic_test_size=test_size,
+                        plan_impl="native"),
+        model=ModelConfig(model="mlp", faithful=False,
+                          compute_dtype="bfloat16"),
+        optim=OptimizerConfig(lr=0.05, momentum=0.5),
+        gossip=GossipConfig(algorithm="dsgd", topology=topology,
+                            mode="metropolis", mixing=mixing, rounds=20,
+                            local_ep=1, local_bs=64, prefetch=prefetch),
+    )
+
+
+def _measure_topology_modes(*, train_size: int, test_size: int,
+                            rounds: int, repeats: int, workers: int = 32,
+                            telemetry=None, prefetch: str = "off",
+                            max_spread: float = 0.0) -> dict:
+    """Dense vs one-peer vs async at n=32 — the r06 headline delta.
+
+    Three legs of the identical workload, differing ONLY in the
+    consensus wire: ``dense`` (complete graph — the all_gather + [n, n]
+    contraction path), ``one_peer`` (the one-peer exponential shift
+    schedule: one ppermute peer per round, same asymptotic contraction
+    over a period), and ``async`` (one-peer + staleness-1 mixing, where
+    round r's communication overlaps round r+1's compute).  The
+    headline ``value`` is the one-peer sync leg; the speedup ratios and
+    per-leg accuracies ride alongside so the regress ledger tracks both
+    the throughput win and that the cheap wire still trains."""
+    kind, _ = _device_peak_flops()
+    legs = {}
+    for name, topology, mixing in (("dense", "complete", "sync"),
+                                   ("one_peer", "one_peer_exp", "sync"),
+                                   ("async", "one_peer_exp", "async")):
+        legs[name] = _measure(
+            _topology_config(topology=topology, mixing=mixing,
+                             train_size=train_size, test_size=test_size,
+                             workers=workers, prefetch=prefetch),
+            rounds, rounds, repeats, max_spread=max_spread,
+            telemetry=telemetry)
+        print(f"# topology-modes {name}: "
+              f"{legs[name]['rounds_per_sec']:.4f} r/s (spread "
+              f"{legs[name]['spread_pct']:.1f}%, "
+              f"acc={legs[name]['avg_test_acc']:.4f})", file=sys.stderr)
+    dense, one_peer, asynk = legs["dense"], legs["one_peer"], legs["async"]
+    return {
+        "metric": f"gossip_topology_modes_dsgd_mlp_{workers}workers",
+        "value": round(one_peer["rounds_per_sec"], 4),
+        "unit": "rounds/sec",
+        "workers": workers,
+        "rounds_per_block": rounds,
+        "device_kind": kind,
+        "prefetch": prefetch,
+        "dense_rounds_per_sec": round(dense["rounds_per_sec"], 4),
+        "one_peer_rounds_per_sec": round(one_peer["rounds_per_sec"], 4),
+        "async_rounds_per_sec": round(asynk["rounds_per_sec"], 4),
+        "one_peer_speedup_vs_dense": round(
+            one_peer["rounds_per_sec"] / dense["rounds_per_sec"], 3),
+        "async_speedup_vs_dense": round(
+            asynk["rounds_per_sec"] / dense["rounds_per_sec"], 3),
+        "async_speedup_vs_one_peer": round(
+            asynk["rounds_per_sec"] / one_peer["rounds_per_sec"], 3),
+        "dense_avg_test_acc": round(dense["avg_test_acc"], 4),
+        "one_peer_avg_test_acc": round(one_peer["avg_test_acc"], 4),
+        "async_avg_test_acc": round(asynk["avg_test_acc"], 4),
+        "spread_pct": round(one_peer["spread_pct"], 2),
+        "samples_per_sec": round(one_peer["samples_per_sec"], 1),
+        "host_gap_pct": round(one_peer["host_gap_pct"], 2),
+    }
+
+
 def _population_config(*, clients: int, cohort: int, train_size: int,
                        test_size: int, local_ep: int | None = None,
                        model: str | None = None, prefetch: str = "off"):
@@ -568,6 +652,15 @@ def main() -> None:
                          "trailing medians) — CI judges the quick "
                          "artifact via 'dopt.obs.regress --candidate' "
                          "instead")
+    ap.add_argument("--topology-modes", action="store_true",
+                    help="run ONLY the r06 mixing-pattern ablation "
+                         "(dense vs one_peer_exp vs async at n=32) and "
+                         "append its own headline to the history ledger")
+    ap.add_argument("--skip-topology", action="store_true",
+                    help="skip the topology-modes legs in the full bench")
+    ap.add_argument("--run-id", default=None,
+                    help="ledger run id for the history append "
+                         "(default: derived from sha + timestamp)")
     ap.add_argument("--idiomatic", action="store_true",
                     help="benchmark the idiomatic model head (post-conv "
                          "ReLUs, logit head + softmax-CE — faithful=False) "
@@ -606,6 +699,34 @@ def main() -> None:
         if args.metrics_out:
             print(f"# wrote telemetry stream to {args.metrics_out}",
                   file=sys.stderr)
+
+    if args.topology_modes:
+        # Standalone r06 mode: the mixing-pattern ablation only, its
+        # own metric key in the ledger (the n=32 MLP wire comparison is
+        # a different workload from the model1 headline, and the
+        # (metric, device_kind) ledger key keeps the windows separate).
+        t_rounds = args.rounds or (3 if args.smoke else 8)
+        t_repeats = 2 if args.smoke else args.repeats
+        tsize, esize = (4_096, 512) if args.smoke else (16_384, 2_048)
+        result = _measure_topology_modes(
+            train_size=tsize, test_size=esize, rounds=t_rounds,
+            repeats=t_repeats, telemetry=tele, prefetch=args.prefetch,
+            max_spread=0.0 if args.smoke else args.max_spread)
+        print(json.dumps(result))
+        if args.history_out and not args.smoke:
+            try:
+                from dopt.obs.regress import append_entry
+
+                entry = append_entry(args.history_out, result,
+                                     run_id=args.run_id)
+                print(f"# appended run {entry['run_id']} "
+                      f"(sha {entry['git_sha'] or 'unknown'}) to "
+                      f"{args.history_out}", file=sys.stderr)
+            except OSError as e:
+                print(f"# bench history append failed: {e}",
+                      file=sys.stderr)
+        _finish_telemetry(result)
+        return
 
     if args.quick:
         # CI-artifact mode: tiny data, two measured rounds per path —
@@ -787,6 +908,21 @@ def main() -> None:
                   f"({popm['rounds_per_sec']:.3f} rounds/s, "
                   f"acc={popm['final_test_acc']:.4f})", file=sys.stderr)
             print(json.dumps(popm))
+    if not args.skip_topology:
+        # r06 legs: the mixing-pattern ablation at n=32 rides the full
+        # bench too (own JSON line; the ratios fold into the headline
+        # so the regress ledger watches the one-peer/async wire win).
+        topo = _measure_topology_modes(
+            train_size=4_096 if args.smoke else 16_384,
+            test_size=512 if args.smoke else 2_048,
+            rounds=rounds, repeats=repeats, telemetry=tele,
+            prefetch=args.prefetch, max_spread=max_spread)
+        print(json.dumps(topo))
+        for k in ("dense_rounds_per_sec", "one_peer_rounds_per_sec",
+                  "async_rounds_per_sec", "one_peer_speedup_vs_dense",
+                  "async_speedup_vs_dense", "async_speedup_vs_one_peer",
+                  "one_peer_avg_test_acc", "async_avg_test_acc"):
+            result[k] = topo[k]
     if not args.skip_faithful:
         faith = _measure(
             _config(fast=False, train_size=train_size, test_size=test_size,
@@ -819,7 +955,8 @@ def main() -> None:
         try:
             from dopt.obs.regress import append_entry
 
-            entry = append_entry(args.history_out, result)
+            entry = append_entry(args.history_out, result,
+                                 run_id=args.run_id)
             print(f"# appended run {entry['run_id']} "
                   f"(sha {entry['git_sha'] or 'unknown'}) to "
                   f"{args.history_out}", file=sys.stderr)

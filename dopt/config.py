@@ -216,7 +216,16 @@ class GossipConfig:
 
     algorithm: str = "dsgd"     # dsgd | nocons | centralized | fedlcon | gossip | choco
     topology: str = "circle"    # circle | star | complete | dynamic | random
-    #                           # | torus | hierarchical
+    #                           # | torus | hierarchical | one_peer_exp
+    # 'one_peer_exp' is the one-peer time-varying exponential schedule
+    # (arXiv:2410.11998): round t mixes every worker with exactly ONE
+    # peer at shift 2^(t mod log2 n), W_t = (I + P_{2^t})/2 with exact
+    # dyadic weights (power-of-2 worker counts only).  The schedule is
+    # stateless per round (pure function of t, like FaultPlan draws) so
+    # it is bit-reproducible, blocked-exact and resume-exact, and its
+    # shift union {0, 1, 2, ..., n/2} rides the sharded circulant
+    # ppermute path (comm_impl='shift'/'auto') — O(lanes·|θ|) bytes per
+    # round instead of the dense all-gather.
     mode: str = "stochastic"    # stochastic | double_stochastic | metropolis | uniform | ones
     rounds: int = 10
     local_ep: int = 4
@@ -232,6 +241,24 @@ class GossipConfig:
     # unbiased estimate from |test| total forwards, per-worker rows are
     # ~W× noisier.  Throughput trims use 'sharded'; parity runs keep
     # 'full'.
+    mixing: str = "sync"        # consensus timing: sync | async
+    # 'sync' (default) is the bulk-synchronous mix: round t's consensus
+    # reads round t's neighbor state — the exact pre-change program.
+    # 'async' is staleness-1 overlapped gossip (the communication/
+    # compute overlap of arXiv:2410.11998 / D-PSGD practice): round t
+    # mixes x_i <- W_ii·x_i(t) + Σ_{j≠i} W_ij·x_j(t-1), consuming the
+    # PREVIOUS round's neighbor state via a double-buffered carry in
+    # the blocked lax.scan — round r's neighbor communication fully
+    # overlaps round r+1's local compute, and a late peer's stale
+    # shard never stalls the round.  Round 0 mixes the shared init, so
+    # async round 0 ≡ sync round 0.  The prev buffer is scan carry +
+    # a checkpoint array ("async_prev"), keeping async runs
+    # bit-reproducible, blocked-exact and resume-exact; crash/churn
+    # repair applies to the FULL matrix before the diag/off-diag
+    # split, so a departed worker's lanes degrade to self-weight
+    # (identity row → pure local step) instead of blocking the mix.
+    # dsgd-only; rejected with the robust layer, link faults/push_sum,
+    # eps sweeps, update_sharding='scatter' and population mode.
     comm_impl: str = "auto"     # consensus collective: auto | dense | shift
     # 'dense'  — all_gather + contraction with the [n, n] mixing matrix
     #            (right for complete/random/arbitrary graphs).

@@ -727,6 +727,59 @@ class GossipTrainer:
                 bucket_bytes=int(g.update_bucket_mb * (1 << 20)))
         scatter_spec = self._scatter_spec
 
+        # Asynchronous (staleness-1) gossip (GossipConfig.mixing): round
+        # t's mix reads the PREVIOUS round's neighbor state — x_i ←
+        # W_ii·x_i(t) + Σ_{j≠i} W_ij·x_j(t−1) — so round r's neighbor
+        # communication fully overlaps round r+1's compute.  The
+        # previous-round buffer is carried engine state ("async_prev"):
+        # a double-buffered scan carry under blocked execution and a
+        # checkpoint array on resume.  "sync" (default) python-gates
+        # every use below, so it compiles the exact pre-change programs.
+        if g.mixing not in ("sync", "async"):
+            raise ValueError(
+                f"unknown gossip mixing {g.mixing!r}; one of sync|async")
+        self._async = g.mixing == "async"
+        if self._async:
+            if g.algorithm != "dsgd":
+                raise ValueError(
+                    "mixing='async' only applies to the single-sweep "
+                    f"dsgd consensus, not {g.algorithm!r}: fedlcon's eps "
+                    "sweeps and choco's compressed exchange have no "
+                    "staleness-1 diag/off-diag split, and matching/"
+                    "nocons have no static schedule to stale against")
+            if robust_active:
+                raise ValueError(
+                    "mixing='async' does not compose with the robust "
+                    "layer (corrupt faults / clip_radius / quarantine "
+                    "screen the CURRENT round's sends; a stale mix has "
+                    "no current wire to screen) — drop one of the two")
+            if self._link_mode:
+                raise ValueError(
+                    "mixing='async' does not compose with link faults / "
+                    "push-sum (the per-staleness [D+1, n, n] stack "
+                    "already models delayed state; staleness-1 is its "
+                    "D=1 special case) — drop one of the two")
+            if g.update_sharding == "scatter":
+                raise ValueError(
+                    "mixing='async' does not compose with "
+                    "update_sharding='scatter' (the bucketed partial "
+                    "contractions assume one source tree; the async "
+                    "diag/off-diag split reads two) — drop one of "
+                    "the two")
+            if cfg.population is not None:
+                raise ValueError(
+                    "mixing='async' does not compose with population "
+                    "mode (a stale neighbor read would cross a cohort "
+                    "rebinding — lane i's previous-round state belongs "
+                    "to a different client) — drop one of the two")
+        is_async = self._async
+        # Round −1's state is defined as the shared init, so async
+        # round 0 mixes exactly what sync round 0 mixes.  Built fresh
+        # from the host tree: round_fn donates params, and the prev
+        # buffer must never alias a donated input.
+        self._async_prev: object = (
+            shard_worker_tree(stacked, self.mesh) if self._async else {})
+
         def mix_once(x, arg):
             """One consensus sweep; ``arg`` is the [n, n] matrix (dense)
             or the [k, n] coefficient table (shift) for the round."""
@@ -748,6 +801,27 @@ class GossipTrainer:
 
             out, _ = jax.lax.scan(body, x, None, length=eps)
             return out
+
+        def async_mix(params, prev, w_off, wdiag):
+            """One staleness-1 consensus sweep: the self-term reads the
+            CURRENT params, every neighbor term reads the PREVIOUS
+            round's state.  ``w_off`` is the zero-diagonal mixing
+            argument ([n, n] matrix or [k, n] shift-coefficient table)
+            and ``wdiag`` the [n] diagonal weights, split host-side
+            AFTER all matrix repairs so a departed lane degrades to
+            diag=1 / off-diag=0 — a pure local step.  The off-diagonal
+            contraction reuses the synchronous collective verbatim
+            (dense or ppermute-shift); only its input tree is one round
+            stale."""
+            neighbors = mix_once(prev, w_off)
+
+            def fold(p, nb):
+                d = wdiag.astype(jnp.float32).reshape(
+                    (-1,) + (1,) * (p.ndim - 1))
+                return (d * p.astype(jnp.float32)
+                        + nb.astype(jnp.float32)).astype(p.dtype)
+
+            return jax.tree.map(fold, params, neighbors)
 
         if is_choco:
             from dopt.ops.compression import make_compressor
@@ -908,14 +982,24 @@ class GossipTrainer:
             return jnp.concatenate(
                 [p.astype(jnp.float32) for p in parts])
 
-        def consensus_phase(params, x_hat, w_matrix, alive, t, cmask):
+        def consensus_phase(params, x_hat, w_matrix, alive, t, cmask,
+                            prev=None, wdiag=None):
             """The round's consensus step, with the Byzantine sends
             injected and (when clip_tau > 0) clipped.  A liar corrupts
             only what it BROADCASTS (``x_send``) — its own carried state
             keeps training honestly, which is the Byzantine model: lies
             on the wire, not a crashed computation.  Returns (params,
-            x_hat, [W] screened sender flags)."""
+            x_hat, [W] screened sender flags).
+
+            Under ``mixing='async'`` (``prev`` is a traced tree, never
+            None) the sweep is the staleness-1 split instead:
+            ``w_matrix`` carries the off-diagonal argument, ``wdiag``
+            the diagonal weights, and the neighbor terms read ``prev``
+            — the previous round's entry state."""
             screened = jnp.zeros(w, jnp.float32)
+            if prev is not None:
+                return (async_mix(params, prev, w_matrix, wdiag), x_hat,
+                        screened)
             if is_choco:
                 params, x_hat = choco_mix(params, x_hat, w_matrix, alive, t)
                 return params, x_hat, screened
@@ -983,11 +1067,16 @@ class GossipTrainer:
 
         def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
                      bweight, train_x, train_y, ex, ey, ew, vidx, vw,
-                     do_eval, cmask=None, quar=None):
+                     do_eval, cmask=None, quar=None, prev=None,
+                     wdiag=None):
+            # Async: this round's ENTRY state is what the neighbors
+            # read NEXT round — it becomes the new prev buffer.
+            entry = params if prev is not None else None
             w_matrix, alive, cmask = effective_inputs(w_matrix, alive,
                                                       quar, cmask)
             params, x_hat, screened = consensus_phase(
-                params, x_hat, w_matrix, alive, t, cmask)
+                params, x_hat, w_matrix, alive, t, cmask, prev=prev,
+                wdiag=wdiag)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
@@ -1006,8 +1095,10 @@ class GossipTrainer:
             # update norm measures the local-training displacement.
             diag = (round_diag(p_t, m_t, params, losses, alive)
                     if diag_on else None)
-            return p_t, m_t, x_hat, pack_host_metrics(tl, ta, evalm, em,
-                                                      screened, diag)
+            packed = pack_host_metrics(tl, ta, evalm, em, screened, diag)
+            if prev is not None:
+                return p_t, m_t, x_hat, entry, packed
+            return p_t, m_t, x_hat, packed
 
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
         self._sharding = worker_sharding(self.mesh)
@@ -1029,7 +1120,8 @@ class GossipTrainer:
 
         def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
                      is_eval, train_x, train_y, ex, ey, ew, vidx, vw,
-                     cmasks=None, streak=None, until=None):
+                     cmasks=None, streak=None, until=None, prev=None,
+                     wdiags=None):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -1045,16 +1137,28 @@ class GossipTrainer:
             without surfacing flags to the host mid-block."""
 
             def body(carry, xs):
+                pv = wd_t = None
                 if fused_quar:
                     p, m, xh, stk, unt = carry
+                elif is_async:
+                    # Double-buffered staleness carry: pv is the
+                    # previous round's entry state; this round's entry
+                    # replaces it after the mix.
+                    p, m, xh, pv = carry
+                    stk = unt = None
                 else:
                     p, m, xh = carry
                     stk = unt = None
-                if has_corrupt:
+                if is_async:
+                    (w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t,
+                     wd_t) = xs
+                    cm_t = None
+                elif has_corrupt:
                     w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t, cm_t = xs
                 else:
                     w_t, alive_t, lim_t, t_t, idx_t, bw_t, ev_t = xs
                     cm_t = None
+                entry = p if is_async else None
                 if fused_quar:
                     # Round-start readmission (mirrors _round_inputs):
                     # an expired sentence clears the bench + streak.
@@ -1064,7 +1168,8 @@ class GossipTrainer:
                     quar_t = (unt > t_t).astype(jnp.float32)
                     w_t, alive_t, cm_t = effective_inputs(w_t, alive_t,
                                                           quar_t, cm_t)
-                p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t, cm_t)
+                p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t,
+                                             cm_t, prev=pv, wdiag=wd_t)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 if use_holdout:
                     p_t, m_t, losses, accs, em = local_phase(
@@ -1088,16 +1193,27 @@ class GossipTrainer:
                     stk, unt = quarantine_update(stk, unt, scr, alive_t,
                                                  t_t)
                     return (p_t, m_t, xh, stk, unt), packed
+                if is_async:
+                    return (p_t, m_t, xh, entry), packed
                 return (p_t, m_t, xh), packed
 
             xs = [w_mats, alive, limits, ts, idx, bw, is_eval]
             if has_corrupt:
                 xs.append(cmasks)
-            carry0 = ((params, mom, x_hat, streak, until) if fused_quar
-                      else (params, mom, x_hat))
+            if is_async:
+                xs.append(wdiags)
+            if fused_quar:
+                carry0 = (params, mom, x_hat, streak, until)
+            elif is_async:
+                carry0 = (params, mom, x_hat, prev)
+            else:
+                carry0 = (params, mom, x_hat)
             carry, packed = jax.lax.scan(body, carry0, tuple(xs))
             if fused_quar:
                 return (*carry, packed)
+            if is_async:
+                params, mom, x_hat, prev = carry
+                return params, mom, x_hat, prev, packed
             params, mom, x_hat = carry
             return params, mom, x_hat, packed
 
@@ -1297,14 +1413,18 @@ class GossipTrainer:
                                if self._has_corrupt else None),
                     "frows": None}
         pairs = [self._round_inputs(t) for t in ts]
-        return {"ts": ts,
+        meta = {"ts": ts,
                 "w_raws": None,
-                "w_mats": np.stack([p[0] for p in pairs]),
+                "w_mats": np.stack([(p[0][0] if self._async else p[0])
+                                    for p in pairs]),
                 "alive": np.stack([p[1] for p in pairs]),
                 "limits": np.stack([p[2] for p in pairs]),
                 "cmasks": (np.stack([p[3] for p in pairs])
                            if self._has_corrupt else None),
                 "frows": [p[4] for p in pairs]}
+        if self._async:
+            meta["wdiags"] = np.stack([p[0][1] for p in pairs])
+        return meta
 
     def _build_block(self, meta: dict) -> dict:
         """The PURE half of one block's host staging: the batch plans
@@ -1404,6 +1524,9 @@ class GossipTrainer:
                 fn = self._block_fn
                 args = (self.params, self.momentum, self.x_hat, *common)
             else:
+                if self._async:
+                    step_kw.update(prev=self._async_prev,
+                                   wdiags=jnp.asarray(payload["wdiags"]))
                 fn = self._block_fn
                 args = (self.params, self.momentum, self.x_hat, *common)
             if stager is None:
@@ -1432,6 +1555,9 @@ class GossipTrainer:
             elif fused_quar:
                 (self.params, self.momentum, self.x_hat, dev_streak,
                  dev_until, packed) = out
+            elif self._async:
+                (self.params, self.momentum, self.x_hat,
+                 self._async_prev, packed) = out
             else:
                 (self.params, self.momentum, self.x_hat, packed) = out
             packed = np.asarray(packed)  # ONE device→host fetch per block
@@ -1756,6 +1882,19 @@ class GossipTrainer:
                      else repair_for_link_drop(w_t, keep))
             mats = split_by_delay(m_eff, delay, self._delay_max)
             return mats, alive, limits, cmask, rows, quar
+        if self._async:
+            # Diag/off-diag split AFTER every repair above: a departed
+            # (crashed/churned/partition-isolated) lane's identity row
+            # becomes diag=1 / off-diag=0 — a pure local step with no
+            # stale read from, or into, the dead lane.  The off-diag
+            # support is a subset of the full support, so the compiled
+            # shift set always covers it.
+            wdiag = np.diag(w_t).astype(np.float32)
+            w_off = (w_t * (1.0 - np.eye(self.num_workers))).astype(
+                np.float32)
+            arg = (coeffs_for_matrix(w_off, self._shift_ids)
+                   if self._shift_ids is not None else w_off)
+            return (arg, wdiag), alive, limits, cmask, rows, quar
         if self._shift_ids is not None:
             return (coeffs_for_matrix(w_t, self._shift_ids), alive, limits,
                     cmask, rows, quar)
@@ -1855,6 +1994,9 @@ class GossipTrainer:
             if self._link_mode:
                 (self.params, self.momentum, self._mass, self._link_buf,
                  self._link_buf_mass, packed) = out
+            elif self._async:
+                (self.params, self.momentum, self.x_hat,
+                 self._async_prev, packed) = out
             else:
                 self.params, self.momentum, self.x_hat, packed = out
             tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
@@ -1945,6 +2087,10 @@ class GossipTrainer:
                     *self._val, do_eval)
             return ("link_round_fn", self._link_round_fn, args, step_kw,
                     alive, quar, frows, do_eval)
+        if self._async:
+            w_t, wdiag = w_t
+            step_kw["prev"] = self._async_prev
+            step_kw["wdiag"] = jnp.asarray(wdiag)
         args = (self.params, self.momentum, self.x_hat, w_t, alive,
                 limits, jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
@@ -1992,6 +2138,11 @@ class GossipTrainer:
         arrays = {"params": self.params, "momentum": self.momentum}
         if self.cfg.gossip.algorithm == "choco":
             arrays["x_hat"] = self.x_hat
+        if self._async:
+            # The staleness-1 buffer is carried engine state: without
+            # it a resumed async run would mix round t against the
+            # wrong previous-round snapshot.
+            arrays["async_prev"] = self._async_prev
         if self._link_mode:
             # Push-sum mass and the staleness buffers are carried engine
             # state: without them a resumed lossy-link run would replay
@@ -2033,6 +2184,13 @@ class GossipTrainer:
                     "choco trainer requires its public-copy state "
                     "('x_hat') in the checkpoint")
             self.x_hat = shard_worker_tree(arrays["x_hat"], self.mesh)
+        if self._async:
+            if "async_prev" not in arrays:
+                raise ValueError(
+                    "mixing='async' trainer requires its previous-round "
+                    "state ('async_prev') in the checkpoint")
+            self._async_prev = shard_worker_tree(arrays["async_prev"],
+                                                 self.mesh)
         if self._link_mode:
             if self._push_sum:
                 if "push_mass" not in arrays:

@@ -26,6 +26,23 @@ fleet quiesces at the boundary, checkpoints once, every process
 re-execs on a fresh port-0 coordinator, training resumes bit-exactly);
 SIGTERM the SUPERVISOR to drain the whole run gracefully (it files a
 ``drain`` command and waits).
+
+Decoupled fleet (no cross-process collectives — the zero-paused-rounds
+rolling restart, built for ``gossip.topology=one_peer_exp`` +
+``gossip.mixing=async``)::
+
+    python -m dopt.serve --preset baseline1 --state-dir run/ \\
+        --num-processes 2 --decoupled \\
+        --set gossip.topology=one_peer_exp --set gossip.mixing=async
+
+spawns N INDEPENDENT single-process daemons (child i leads its own
+``run/p<i>/`` state subdir), linked only by per-process liveness
+heartbeat files in ``run/``: a peer that drains or goes stale is
+auto-``leave``d from each survivor's membership (identity mixing rows
+— the round proceeds without it) and auto-``join``ed back when its
+heartbeat returns.  SIGTERM a CHILD and only THAT child drains,
+checkpoints and is respawned — the survivors' round watermark never
+pauses; SIGTERM the SUPERVISOR to drain every child gracefully.
 """
 
 from __future__ import annotations
@@ -37,6 +54,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import time
 from pathlib import Path
 
 from dopt.serve.daemon import EX_RESTART, ServeDaemon
@@ -100,13 +118,18 @@ def run_daemon(args, argv: list[str]) -> int:
         process_id=args.process_id or 0,
         num_processes=args.num_processes,
         rules=rules,
+        fleet_rank=args.fleet_rank or 0,
+        fleet_size=args.fleet_size or 1,
+        fleet_dir=args.fleet_dir,
+        peer_timeout_s=args.peer_timeout,
     ).start()
     if daemon.is_leader and daemon.admin is not None:
         print(f"dopt serve: admin on http://{args.admin_host}:"
               f"{daemon.admin.port} (state {args.state_dir})",
               file=sys.stderr, flush=True)
     rc = daemon.serve()
-    if rc == EX_RESTART and args.process_id is None:
+    if rc == EX_RESTART and args.process_id is None \
+            and args.fleet_rank is None:
         # Self-managed single process: the drain checkpointed, now
         # become a fresh process image and resume — the rolling
         # restart with a fleet of one.  Supervised children return the
@@ -239,6 +262,112 @@ def _supervise(args, argv: list[str], state: Path) -> int:
         return 1
 
 
+def run_decoupled_supervisor(args, argv: list[str]) -> int:
+    """Parent of a DECOUPLED fleet: N independent single-process
+    daemons, each leading its own ``<state>/p<i>/`` subdir, linked only
+    by liveness heartbeats in ``<state>/``.  Respawn ONLY the child
+    that asked (exit ``EX_RESTART``) — the survivors keep ticking
+    through it: the zero-paused-rounds rolling restart."""
+    state = Path(args.state_dir)
+    state.mkdir(parents=True, exist_ok=True)
+    term = {"fired": False}
+
+    def _term(signum, frame):
+        # Whole-run drain: one drain command PER child queue (each
+        # daemon is its own leader — there is no fleet queue).  Unique
+        # ids for the same reason run_supervisor's handler uses them.
+        if not term["fired"]:
+            term["fired"] = True
+            import uuid
+
+            from dopt.serve.control import CommandQueue, make_command
+
+            for i in range(args.num_processes):
+                sub = state / f"p{i}"
+                sub.mkdir(parents=True, exist_ok=True)
+                CommandQueue(sub / "commands.jsonl").submit(
+                    make_command(
+                        "drain",
+                        id=f"supervisor-term-{uuid.uuid4().hex[:8]}"))
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    return _supervise_decoupled(args, argv, state, term)
+
+
+def _supervise_decoupled(args, argv: list[str], state: Path,
+                         term: dict) -> int:
+    log_dir = state / "logs"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    base = _strip_decoupled_flags(argv)
+    gens = [0] * args.num_processes
+
+    def spawn(i: int):
+        child_argv = base + [
+            "--state-dir", str(state / f"p{i}"),
+            "--fleet-rank", str(i),
+            "--fleet-size", str(args.num_processes),
+            "--fleet-dir", str(state)]
+        log = open(log_dir / f"p{i}-gen{gens[i]}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dopt.serve", *child_argv],
+            stdout=log, stderr=subprocess.STDOUT)
+        return [proc, log]
+
+    procs = {i: spawn(i) for i in range(args.num_processes)}
+    failed = False
+    while procs:
+        time.sleep(0.2)
+        for i in list(procs):
+            proc, log = procs[i]
+            rc = proc.poll()
+            if rc is None:
+                continue
+            log.close()
+            del procs[i]
+            if rc == EX_RESTART and not failed:
+                gens[i] += 1
+                print(f"dopt serve: process {i} rolling restart -> "
+                      f"gen {gens[i]} (peers keep ticking)",
+                      file=sys.stderr, flush=True)
+                procs[i] = spawn(i)
+            elif rc not in (0, EX_RESTART):
+                # One child failed hard: drain the survivors (SIGINT
+                # always drains) rather than training a degraded fleet
+                # forever under an absent supervisor verdict.
+                failed = True
+                print(f"dopt serve: process {i} failed (exit {rc}, "
+                      f"log {log_dir / f'p{i}-gen{gens[i]}.log'}); "
+                      "draining survivors", file=sys.stderr, flush=True)
+                for other, _ in procs.values():
+                    try:
+                        other.send_signal(signal.SIGINT)
+                    except OSError:
+                        pass
+    if failed:
+        return 1
+    print("dopt serve: decoupled fleet drained", file=sys.stderr)
+    return 0
+
+
+def _strip_decoupled_flags(argv: list[str]) -> list[str]:
+    """Child argv for a decoupled spawn: drop the supervisor-level
+    flags (the spawn appends the per-child ones)."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in ("--state-dir", "--num-processes", "--fleet-port",
+                 "--fleet-rank", "--fleet-size", "--fleet-dir"):
+            skip = True
+            continue
+        if a == "--decoupled":
+            continue
+        out.append(a)
+    return out
+
+
 def _gloo_transport_flake(log_dir: Path, generation: int) -> bool:
     for log in log_dir.glob(f"gen{generation}-p*.log"):
         try:
@@ -302,14 +431,40 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--num-processes", type=int, default=1,
                     help="multi-process fleet size (real "
                          "jax.distributed + gloo CPU collectives)")
+    ap.add_argument("--decoupled", action="store_true",
+                    help="with --num-processes N: run N INDEPENDENT "
+                         "single-process daemons (child i leads "
+                         "<state>/p<i>/) linked only by liveness "
+                         "heartbeats — no cross-process collectives, so "
+                         "a peer's restart never pauses the survivors; "
+                         "built for gossip.topology=one_peer_exp + "
+                         "gossip.mixing=async")
+    ap.add_argument("--peer-timeout", type=float, default=10.0,
+                    metavar="SECONDS",
+                    help="decoupled fleets: a peer whose liveness "
+                         "heartbeat is older than this is auto-left "
+                         "from the membership until it returns")
     ap.add_argument("--devices-per-proc", type=int, default=4,
                     help="virtual CPU devices per fleet process")
     ap.add_argument("--process-id", type=int, default=None,
                     help="(internal) run as fleet child with this id")
     ap.add_argument("--handoff", default=None,
                     help="(internal) coordinator handoff file path")
+    ap.add_argument("--fleet-rank", type=int, default=None,
+                    help="(internal) run as decoupled-fleet child with "
+                         "this rank")
+    ap.add_argument("--fleet-size", type=int, default=None,
+                    help="(internal) decoupled-fleet size")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="(internal) shared liveness-heartbeat dir")
     args = ap.parse_args(argv)
 
+    if args.decoupled and args.process_id is not None:
+        ap.error("--decoupled and --process-id are mutually exclusive")
+    if args.decoupled and args.fleet_rank is None:
+        if args.num_processes < 2:
+            ap.error("--decoupled requires --num-processes >= 2")
+        return run_decoupled_supervisor(args, argv)
     if args.num_processes > 1 and args.process_id is None:
         return run_supervisor(args, argv)
     if args.process_id is not None and args.handoff is None:

@@ -39,6 +39,32 @@ bit-exactly on a fresh coordinator — SPMD collectives make per-host
 independence cooperative, so "one host at a time" means the run
 survives each host's restart in turn, not that collectives proceed
 through it.
+
+**Decoupled fleets** (``--decoupled``) kill that round barrier: each
+process is an independent single-host daemon (its own state subdir,
+queue, ledger, checkpoints — its own leader), and NO collective spans
+processes, so a departing peer cannot quiesce anyone.  Liveness rides
+per-process heartbeat files (``liveness-p<rank>.json`` in the shared
+fleet dir, refreshed at every boundary and stamped ``draining``/
+``restarting`` on the way out): each daemon folds peer liveness into
+its OWN ``MembershipLog`` at each boundary — a peer gone (stale
+heartbeat or an explicit drain stamp) auto-``leave``s that peer's lane
+range, the existing churn repair degrades those mixing rows to
+identity (with ``topology='one_peer_exp'`` + ``mixing='async'`` the
+survivors' mix is pure self-weight — no wire to the missing peer),
+and a fresh heartbeat auto-``join``s the lanes back.  A SIGTERM'd
+peer drains to its boundary, checkpoints, exits ``EX_RESTART``; the
+supervisor respawns ONLY that child and it resumes bit-exactly —
+survivors never stop ticking: a rolling restart with zero paused
+rounds.  The liveness-driven auto rows are wall-clock-scheduled
+(WHICH boundary sees a peer away depends on timing), so unlike every
+other ledger row they are not bit-reproducible across runs; each
+process's canonical stream remains self-consistent and replayable
+(the rows land in the ledger like any commanded transition).  Each
+daemon still simulates the full lane fleet locally (peers' lanes are
+frozen by the away mask, not computed remotely) — decoupled mode is
+the control-plane half of decentralization; cross-host lane exchange
+stays with the SPMD fleet path.
 """
 
 from __future__ import annotations
@@ -71,6 +97,7 @@ _METRICS_FILE = "metrics.jsonl"
 _CKPT_DIR = "ckpt"
 _EPOCH_DIR = "epoch"
 _RESTART_FLAG = "restart-requested"
+_LIVENESS_PREFIX = "liveness-p"
 
 
 def build_serve_trainer(cfg, membership):
@@ -148,10 +175,17 @@ class ServeDaemon:
                  admin_port: int | None = None,
                  rules=None, process_id: int = 0, num_processes: int = 1,
                  directive_poll_s: float = 0.05,
-                 directive_max_polls: int = 12000):
+                 directive_max_polls: int = 12000,
+                 fleet_rank: int = 0, fleet_size: int = 1,
+                 fleet_dir=None, peer_timeout_s: float = 10.0):
         if on_term not in ("restart", "drain"):
             raise ValueError(
                 f"on_term must be 'restart' or 'drain', got {on_term!r}")
+        if int(fleet_size) > 1 and int(num_processes) > 1:
+            raise ValueError(
+                "a decoupled fleet (fleet_size > 1) and an SPMD fleet "
+                "(num_processes > 1) are mutually exclusive: decoupled "
+                "daemons are independent single-process leaders")
         self.base_cfg = cfg
         self.cfg = cfg
         self.state_dir = Path(state_dir)
@@ -166,6 +200,19 @@ class ServeDaemon:
         self._rules = rules
         self._directive_poll_s = float(directive_poll_s)
         self._directive_max_polls = int(directive_max_polls)
+        # Decoupled-fleet identity: rank within the fleet of
+        # independent daemons, and the SHARED parent dir carrying every
+        # process's liveness heartbeat.  SPMD fleets share state_dir,
+        # so the default fleet_dir covers them too (the leader's
+        # heartbeat is what _await_directive's timeout reports).
+        self.fleet_rank = int(fleet_rank)
+        self.fleet_size = int(fleet_size)
+        self.fleet_dir = (Path(fleet_dir) if fleet_dir is not None
+                          else self.state_dir)
+        self.peer_timeout_s = float(peer_timeout_s)
+        self._decoupled = self.fleet_size > 1
+        self._liveness_rank = (self.fleet_rank if self._decoupled
+                               else self.process_id)
 
         self.queue = CommandQueue(self.state_dir / _COMMANDS_FILE)
         self.ledger = ControlLedger(self.state_dir / _APPLIED_FILE)
@@ -301,6 +348,7 @@ class ServeDaemon:
         self._install_signals()
         self.status = "serving"
         self._write_status()
+        self._write_liveness(int(self.trainer.round))
         return self
 
     def _stream_control_ids(self, round_idx: int) -> set[str]:
@@ -387,6 +435,7 @@ class ServeDaemon:
         self._observe_latency(
             "boundary_tick",
             time.perf_counter() - tick0, t)  # dopt: allow-wallclock -- boundary_tick SLO latency meter, reporting only
+        self._write_liveness(t)
         self._profile_tick(t, verdict)
         return verdict
 
@@ -463,6 +512,15 @@ class ServeDaemon:
                 auto_ids.append(c["id"])
         if self.monitor is not None:
             self._alerts_seen = len(self.monitor.alerts)
+
+        # Decoupled fleets: peer liveness becomes membership here.
+        # Appended AFTER the queue sweep (operator commands win the
+        # boundary) and unconditionally on pause — a liveness rejoin
+        # restores a provisioned peer, it does not admit a new one.
+        if self._decoupled:
+            for c in self._peer_transitions(t):
+                applied.append(c)
+                auto_ids.append(c["id"])
 
         if self._term:
             stop = stop or self._term_signal or self.on_term
@@ -593,6 +651,80 @@ class ServeDaemon:
             "metrics": str(self.metrics_path),
         }, indent=2))
 
+    # -- liveness heartbeats & decoupled membership --------------------
+    def _liveness_path(self, rank: int) -> Path:
+        return self.fleet_dir / f"{_LIVENESS_PREFIX}{int(rank)}.json"
+
+    def _write_liveness(self, round_: int) -> None:
+        """Refresh this process's heartbeat file.  Operational state
+        only (like ``serve.json``): never a telemetry event, never
+        replay data — a lost heartbeat costs at worst one spurious
+        peer-side leave/join cycle."""
+        from dopt.utils.metrics import atomic_write_text
+
+        try:
+            atomic_write_text(self._liveness_path(self._liveness_rank),
+                              json.dumps({
+                                  "pid": os.getpid(),
+                                  "rank": self._liveness_rank,
+                                  "round": int(round_),
+                                  "status": self.status,
+                                  "ts": time.time(),  # dopt: allow-wallclock -- liveness heartbeat stamp, operational file only
+                              }))
+        except OSError:
+            pass   # a missed heartbeat is survivable; a crash here is not
+
+    @staticmethod
+    def lanes_of(rank: int, fleet_size: int, num_workers: int) -> range:
+        """The lane range decoupled process ``rank`` is authoritative
+        for: the same even W//N split the SPMD mesh shards."""
+        rank, n = int(rank), int(fleet_size)
+        w = int(num_workers)
+        return range(rank * w // n, (rank + 1) * w // n)
+
+    def _peer_state(self, rank: int) -> str:
+        """'live', 'gone', or 'unknown' (never started / torn write —
+        no transition either way) from the peer's heartbeat file."""
+        try:
+            info = json.loads(self._liveness_path(rank).read_text())
+        except (OSError, ValueError):
+            return "unknown"
+        if str(info.get("status")) in ("draining", "drained",
+                                       "restarting"):
+            return "gone"   # explicit departure stamp: no timeout wait
+        age = time.time() - float(info.get("ts", 0.0))  # dopt: allow-wallclock -- peer staleness vs heartbeat stamp, liveness only
+        return "gone" if age > self.peer_timeout_s else "live"
+
+    def _peer_transitions(self, t: int) -> list[dict[str, Any]]:
+        """Decoupled fleets: fold peer liveness into auto membership
+        commands for THIS boundary.  A gone peer's lanes leave (the
+        churn repair turns their mixing rows to identity, so the round
+        proceeds without them); a returned peer's lanes join back.
+        Wall-clock-scheduled by construction — the rows are ledgered
+        ``auto`` like the drop_rate auto-pause, and WHICH boundary
+        carries them varies run to run (documented in the module
+        docstring); everything downstream of the ledger stays
+        deterministic."""
+        w = int(self.trainer.num_workers)
+        away = self.membership.away_at(t, w)
+        out: list[dict[str, Any]] = []
+        for rank in range(self.fleet_size):
+            if rank == self.fleet_rank:
+                continue
+            state = self._peer_state(rank)
+            if state == "unknown":
+                continue
+            for i in self.lanes_of(rank, self.fleet_size, w):
+                if state == "gone" and not away[i]:
+                    out.append(make_command(
+                        "membership", worker=int(i), action="leave",
+                        id=f"auto-liveness-leave-r{t}-w{i}"))
+                elif state == "live" and away[i]:
+                    out.append(make_command(
+                        "membership", worker=int(i), action="join",
+                        id=f"auto-liveness-join-r{t}-w{i}"))
+        return out
+
     # -- multi-process directives --------------------------------------
     def _directive_path(self, seq: int, t: int) -> Path:
         # Keyed by (visit sequence, round): a rebuild revisits the same
@@ -608,18 +740,58 @@ class ServeDaemon:
                           json.dumps(directive))
 
     def _await_directive(self, seq: int, t: int) -> dict[str, Any]:
+        # Capped exponential backoff, not a fixed-cadence spin: the
+        # first polls catch a prompt leader within ~poll_s, the 1s cap
+        # bounds the latency a slow boundary pays, and the total wall
+        # budget matches the old poll_s × max_polls product so tuned
+        # deployments keep their timeout.
         path = self._directive_path(seq, t)
-        for _ in range(self._directive_max_polls):
+        budget = self._directive_poll_s * self._directive_max_polls
+        deadline = time.monotonic() + budget  # dopt: allow-wallclock -- follower directive-barrier timeout, control plane only
+        delay = self._directive_poll_s
+        while True:
             if path.exists():
                 try:
                     return json.loads(path.read_text())
                 except ValueError:
                     pass   # racing the rename: retry
-            time.sleep(self._directive_poll_s)
+            left = deadline - time.monotonic()  # dopt: allow-wallclock -- follower directive-barrier timeout, control plane only
+            if left <= 0:
+                break
+            time.sleep(min(delay, left))
+            delay = min(delay * 2.0, max(self._directive_poll_s, 1.0))
         raise RuntimeError(
             f"process {self.process_id}: no boundary directive for round "
-            f"{t} (visit {seq}) after {self._directive_max_polls} polls "
-            "— leader gone?")
+            f"{t} (visit {seq}) after {budget:.0f}s; leader liveness: "
+            f"{self._leader_liveness_age()}; last directive published: "
+            f"{self._last_directive_seen()}.  A fresh liveness file "
+            "means the leader is alive but slow (raise "
+            "directive_poll_s/directive_max_polls); a stale or missing "
+            "one means the leader is gone (restart the fleet)")
+
+    def _leader_liveness_age(self) -> str:
+        """The leader heartbeat's age, rendered for the directive
+        timeout — the one bit that tells a dead leader from a slow
+        one."""
+        p = self._liveness_path(0)
+        try:
+            info = json.loads(p.read_text())
+            age = time.time() - float(info["ts"])  # dopt: allow-wallclock -- timeout diagnostics, reporting only
+        except (OSError, ValueError, KeyError, TypeError):
+            return f"no heartbeat file at {p}"
+        return (f"heartbeat {age:.1f}s old "
+                f"(status {info.get('status')!r}, "
+                f"round {info.get('round')}, pid {info.get('pid')})")
+
+    def _last_directive_seen(self) -> str:
+        """The newest directive seq present in the epoch dir (timeout
+        diagnostics: 'leader stopped publishing after seq K')."""
+        try:
+            names = sorted(p.name for p in
+                           (self.state_dir / _EPOCH_DIR).glob("*.json"))
+        except OSError:
+            names = []
+        return names[-1].rsplit(".", 1)[0] if names else "none"
 
     # -- on-demand live profiling (POST /admin/profile) ----------------
     def request_profile(self, rounds: int) -> dict[str, Any]:
@@ -811,6 +983,10 @@ class ServeDaemon:
             self.telemetry = None
         self.ledger.close()
         self._write_status()
+        # The departure stamp: peers reading "draining"/"restarting"
+        # leave this process's lanes WITHOUT waiting out the staleness
+        # timeout — the fast half of the decoupled drain protocol.
+        self._write_liveness(int(getattr(self.trainer, "round", 0)))
 
     # -- admin-facing helpers ------------------------------------------
     def submit(self, command: dict[str, Any]) -> dict[str, Any]:
@@ -832,6 +1008,8 @@ class ServeDaemon:
             "engine": getattr(trainer, "engine_kind", None),
             "max_rounds": self.max_rounds,
             "num_processes": self.num_processes,
+            "fleet_rank": self.fleet_rank,
+            "fleet_size": self.fleet_size,
             "profile": self.profile_status(),
         }
 

@@ -40,7 +40,7 @@ import numpy as np
 
 # Reference spells it "compelete" (simulators.py:54); accept both.
 _TOPOLOGIES = ("circle", "ring", "star", "complete", "compelete", "dynamic",
-               "random", "torus", "hierarchical")
+               "random", "torus", "hierarchical", "one_peer_exp")
 _MODES = ("stochastic", "double_stochastic", "ones", "metropolis", "uniform")
 
 
@@ -129,6 +129,29 @@ class Topology:
             intra[s:s + size, s:s + size] = blk
         global_g = np.ones((n, n)) - np.eye(n)
         return [intra] * (period - 1) + [global_g]
+
+    @staticmethod
+    def one_peer_exp(n: int) -> list[np.ndarray]:
+        """One-peer exponential schedule (arXiv:2410.11998 / D-PSGD
+        practice): log2(n) DIRECTED single-peer graphs, graph k carrying
+        the edge i -> (i + 2^k) mod n, cycled per round.  Every worker
+        talks to exactly ONE peer each round — the cheapest possible
+        round wire — and the union over a period is the exponential
+        graph, so the schedule still contracts like a well-connected
+        topology.  Requires a power-of-2 worker count: that is what
+        makes every per-round matrix (I + P_{2^k})/2 doubly stochastic
+        (P is then a permutation with no fixed points)."""
+        if n < 2 or n & (n - 1):
+            raise ValueError(
+                f"one_peer_exp needs a power-of-2 worker count >= 2, "
+                f"got {n}")
+        idx = np.arange(n)
+        graphs = []
+        for k in range(n.bit_length() - 1):
+            g = np.zeros((n, n))
+            g[idx, (idx + (1 << k)) % n] = 1.0
+            graphs.append(g)
+        return graphs
 
     @staticmethod
     def torus(n: int) -> list[np.ndarray]:
@@ -334,6 +357,23 @@ def build_mixing_matrices(
         # Weighted Average.ipynb cell 29).  We accept it explicitly as
         # 'ones' but reject typos loudly.
         raise ValueError(f"unknown mode {mode!r}; one of {_MODES}")
+    if topology.lower() == "one_peer_exp":
+        # One-peer exponential graphs define their OWN weights: every
+        # round is exactly W_t = (I + P_{2^t mod log2 n})/2 — dyadic 0.5
+        # entries (bit-exact in f32/bf16), doubly stochastic, stateless
+        # per round via the for_round(t) schedule selector.  The weight
+        # mode is ignored (the matrix IS the algorithm) and the lazy
+        # self-loop would double-apply the built-in self-weight.
+        if self_weight:
+            raise ValueError(
+                "topology='one_peer_exp' bakes its own exact dyadic "
+                "self-weights (W_t = (I + P)/2); self_weight=True only "
+                "applies to the reference weight modes — drop one of "
+                "the two")
+        mats = [(np.eye(n) + g) / 2.0
+                for g in build_adjacency(topology, n)]
+        return MixingMatrices(topology="one_peer_exp", mode=mode_l,
+                              matrices=tuple(mats))
     graphs = build_adjacency(topology, n, p=p, schedule_len=schedule_len,
                              seed=seed, groups=groups, period=period)
     rng = np.random.default_rng(seed)
@@ -400,14 +440,23 @@ def schedule_shift_decomposition(
     ppermute path needs a single static shift set that covers every
     round's matrix; per-round coefficients then become data
     (``coeffs_for_matrix``).  ``extra_shifts`` lets the engine force
-    shift 0 into the set when dropout repair may add identity rows.
-    Returns ``None`` when the union exceeds ``max_shifts`` (the dense
-    all_gather path is then the better mapping)."""
-    ids: set[int] = set(int(s) for s in extra_shifts)
+    shift 0 into the set when dropout repair may add identity rows —
+    the repaired matrix then stays inside the compiled set even when
+    the clean schedule has a zero diagonal.  Returns ``None`` when the
+    union exceeds ``max_shifts`` (the dense all_gather path is then the
+    better mapping); a ``None`` bail NEVER mutates ``extra_shifts``
+    (callers may hand a long-lived set) and bails as soon as the union
+    blows the budget rather than decomposing the rest of the schedule.
+    Shifts are canonicalised mod n, so ``extra_shifts=(-1,)`` means the
+    n-1 diagonal ``shift_decomposition`` would emit."""
+    n = mixing.n
+    ids: set[int] = {int(s) % n for s in extra_shifts}
     for m in mixing.matrices:
         dec = shift_decomposition(m)
         assert dec is not None
         ids.update(s for s, _ in dec)
+        if max_shifts is not None and len(ids) > max_shifts:
+            return None
     out = tuple(sorted(ids))
     if max_shifts is not None and len(out) > max_shifts:
         return None

@@ -2,8 +2,10 @@
 
 Runs both engines through crash + corrupt + straggler + msg_drop +
 msg_delay + churn simultaneously (the full degraded-network regime from
-``dopt.faults``) on a small synthetic workload and asserts the three
-things a robust trainer owes you:
+``dopt.faults``) on a small synthetic workload — plus a third leg,
+``gossip-async`` (one-peer exponential topology + staleness-1 async
+mixing) under the process-fault storm those modes compose with — and
+asserts the three things a robust trainer owes you:
 
 1. **Convergence to tolerance** — the fleet still learns: final train
    loss beats the first round's by a margin, and every logged metric is
@@ -64,12 +66,18 @@ _MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
 _OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
 
 
-def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig]:
+def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig, FaultConfig]:
     """Seeded random draw of the round's fault cocktail: (gossip
-    cocktail, federated cocktail).  The federated one adds the
-    Byzantine nan liar (screened by the always-on non-finite guard) and
-    the heavy straggler deadline that staleness-aware aggregation
-    buffers; the gossip one leans on the link model + push-sum."""
+    cocktail, federated cocktail, async-gossip cocktail).  The
+    federated one adds the Byzantine nan liar (screened by the
+    always-on non-finite guard) and the heavy straggler deadline that
+    staleness-aware aggregation buffers; the gossip one leans on the
+    link model + push-sum.  The async one draws only the process
+    faults (crash + straggler + churn) at HIGHER rates: link faults
+    and push-sum are rejected by ``mixing='async'`` by design (the
+    [D+1, n, n] staleness stack already subsumes staleness-1), so the
+    storm concentrates on the repairs the diag/off-diag split must
+    survive."""
     rng = np.random.default_rng([0xC0C7A11, seed])
 
     def u(lo, hi):
@@ -85,7 +93,10 @@ def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig]:
         corrupt=u(0.05, 0.15), corrupt_mode="nan",
         msg_drop=u(0.05, 0.15), msg_delay=u(0.1, 0.3), msg_delay_max=3,
         churn=u(0.02, 0.08), churn_span=int(rng.integers(2, 4)))
-    return gossip, fed
+    asynk = FaultConfig(
+        crash=u(0.08, 0.18), straggle=u(0.1, 0.3), straggle_frac=0.5,
+        churn=u(0.05, 0.12), churn_span=int(rng.integers(2, 4)))
+    return gossip, fed, asynk
 
 
 def build_cfg(engine: str, seed: int, rounds: int,
@@ -95,7 +106,7 @@ def build_cfg(engine: str, seed: int, rounds: int,
     # thereby pin the NEW per-round convergence gauges too — the PR 8/10
     # guarantee extended to the diagnostics layer.
     pf = "on" if prefetch else "off"
-    gossip_fc, fed_fc = cocktail(seed)
+    gossip_fc, fed_fc, async_fc = cocktail(seed)
     if engine == "gossip":
         return ExperimentConfig(
             name=f"chaos-gossip-{seed}", seed=100 + seed, data=_DATA,
@@ -106,6 +117,21 @@ def build_cfg(engine: str, seed: int, rounds: int,
                                 correction="push_sum", prefetch=pf,
                                 diagnostics="on"),
             faults=gossip_fc)
+    if engine == "gossip-async":
+        # The new-mode leg: one-peer exponential schedule + staleness-1
+        # mixing, under the process-fault storm.  Every soak invariant
+        # (blocked/prefetched/resumed bit-identity, ledger replay,
+        # canonical stream + alert parity) applies unchanged.
+        return ExperimentConfig(
+            name=f"chaos-gossip-async-{seed}", seed=100 + seed,
+            data=_DATA, model=_MODEL, optim=_OPTIM,
+            gossip=GossipConfig(algorithm="dsgd",
+                                topology="one_peer_exp",
+                                mode="metropolis", rounds=rounds,
+                                local_ep=1, local_bs=32,
+                                mixing="async", prefetch=pf,
+                                diagnostics="on"),
+            faults=async_fc)
     return ExperimentConfig(
         name=f"chaos-fed-{seed}", seed=100 + seed, data=_DATA,
         model=_MODEL, optim=_OPTIM,
@@ -121,7 +147,7 @@ def build_trainer(engine: str, seed: int, rounds: int,
     from dopt.engine import FederatedTrainer, GossipTrainer
 
     cfg = build_cfg(engine, seed, rounds, prefetch=prefetch)
-    return (GossipTrainer(cfg) if engine == "gossip"
+    return (GossipTrainer(cfg) if engine.startswith("gossip")
             else FederatedTrainer(cfg))
 
 
@@ -205,7 +231,8 @@ def soak_one(engine: str, seed: int, rounds: int, tol: float,
     from dopt.obs.events import DIAG_GAUGES
 
     gauge_names = {e["name"] for e in mem.events if e["kind"] == "gauge"}
-    want = set(DIAG_GAUGES) | {"consensus_distance" if engine == "gossip"
+    want = set(DIAG_GAUGES) | {"consensus_distance"
+                               if engine.startswith("gossip")
                                else "lane_dispersion"}
     assert want <= gauge_names, \
         f"diagnostic gauges missing from the stream: {want - gauge_names}"
@@ -433,8 +460,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0,
                     help="cocktail seed (each seed is a different storm)")
-    ap.add_argument("--engine", choices=["both", "gossip", "federated"],
-                    default="both")
+    ap.add_argument("--engine",
+                    choices=["all", "both", "gossip", "gossip-async",
+                             "federated"],
+                    default="all",
+                    help="'all' runs the sync-gossip, async-gossip and "
+                         "federated legs; 'both' is the legacy "
+                         "sync-gossip + federated pair")
     ap.add_argument("--tol", type=float, default=0.0,
                     help="slack added to the final-loss-beats-first check")
     ap.add_argument("--kill", action="store_true",
@@ -473,8 +505,9 @@ def main(argv: list[str] | None = None) -> int:
 
     import tempfile
 
-    engines = (["gossip", "federated"] if args.engine == "both"
-               else [args.engine])
+    engines = {"all": ["gossip", "gossip-async", "federated"],
+               "both": ["gossip", "federated"]}.get(args.engine,
+                                                    [args.engine])
     metrics_sink = None
     if args.metrics_out:
         from dopt.obs import JsonlSink

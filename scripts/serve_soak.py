@@ -41,6 +41,12 @@ Two further modes:
   report with finite p50/p99 for boundary-tick, command-apply,
   checkpoint-save/restore and alert latency (a sensitized drop-rate
   rule turns the membership churn into a real measured alert).
+  ``--fleet`` then runs the DECOUPLED async/one-peer leg on top: two
+  independent daemons on ``gossip.topology=one_peer_exp`` +
+  ``gossip.mixing=async``, a mid-run SIGTERM of the rank-1 child, and
+  the zero-paused-rounds assertion — the survivor's round watermark
+  strictly increases through the whole restart window while the
+  liveness protocol ledgers the peer's lanes leaving and rejoining.
 * ``--minutes N`` — the LONG soak (the ROADMAP 1-hour item as a flag,
   not a rewrite): a resident run kept alive for N wall minutes under
   seeded randomized live churn (membership leave/join, lr and
@@ -353,6 +359,186 @@ def run_fleet_soak(args, root: Path) -> int:
     return 0
 
 
+def sigterm_decoupled_child(state_dir: Path, rank: int) -> bool:
+    """SIGTERM one decoupled-fleet child by its state subdir (no
+    leading dashes in the pgrep pattern)."""
+    out = subprocess.run(
+        ["pgrep", "-f", f"state-dir {state_dir}/p{rank} "],
+        capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split()]
+    if not pids:
+        return False
+    os.kill(pids[0], signal.SIGTERM)
+    return True
+
+
+def run_decoupled_soak(args, root: Path) -> int:
+    """The async/one-peer DECOUPLED fleet leg (``--fleet`` runs it
+    after the SPMD leg): two independent daemons on
+    ``gossip.topology=one_peer_exp`` + ``gossip.mixing=async``, SIGTERM
+    the rank-1 child mid-run, and assert the tentpole property — ZERO
+    PAUSED ROUNDS: the survivor's round watermark strictly increases
+    through the entire SIGTERM → re-exec → resume window (an SPMD
+    fleet's survivor freezes in a collective there until the whole
+    generation respawns).  Also asserts the restarted child resumed
+    (restarts >= 1, stream passes ``dopt.obs.check``) and that the
+    liveness protocol ledgered the peer's leave AND rejoin on the
+    survivor before the drain."""
+    state = root / "decoupled"
+    if state.exists():
+        import shutil
+
+        shutil.rmtree(state)
+    state.mkdir(parents=True)
+    # Event-driven, not round-budgeted: the restarted child pays a
+    # fresh python + jax re-init before its heartbeat returns, and on
+    # slow CI that can outlast any fixed round count.  So the fleet
+    # runs with an effectively unbounded round cap, the harness waits
+    # for each phase (restart window closed, rejoin ledgered on the
+    # survivor) and then drains everyone by SIGTERM — the 1500s
+    # ceiling is the only clock.
+    kill_at = 8
+    base = serve_args("gossip", 100000, args.seed,
+                      args.checkpoint_every)
+    cmd = [sys.executable, "-m", "dopt.serve", *base,
+           "--set", "gossip.topology=one_peer_exp",
+           "--set", "gossip.mixing=async",
+           "--state-dir", str(state), "--no-admin",
+           "--num-processes", "2", "--decoupled", "--peer-timeout", "5"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    print(f"[decoupled] one_peer_exp+async, rank-1 SIGTERM at >= "
+          f"{kill_at}, drain once the rejoin lands", flush=True)
+    t0 = time.time()
+    sup = subprocess.Popen(cmd, env=env, cwd=REPO)
+    status_path = state / "p0" / "serve.json"
+
+    def watermark() -> int | None:
+        try:
+            st = json.loads(status_path.read_text())
+        except (OSError, ValueError):
+            return None
+        return (int(st["round"])
+                if st.get("status") == "serving" else None)
+
+    def peer_live() -> dict:
+        try:
+            return json.loads((state / "liveness-p1.json").read_text())
+        except (OSError, ValueError):
+            return {}
+
+    def ledgered(action: str) -> set[int]:
+        workers = set()
+        try:
+            lines = (state / "p0" / "applied.jsonl").read_text()
+        except OSError:
+            return workers
+        for line in lines.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("status") == "applied" \
+                    and rec.get("cmd") == "membership" \
+                    and rec.get("action") == action:
+                workers.add(int(rec.get("worker", -1)))
+        return workers
+
+    killed = False
+    killed_pid = None
+    samples: list[int] = []
+    window_open = False
+    drained = False
+    timeout_s = 1500.0
+    while sup.poll() is None:
+        time.sleep(0.1)
+        if time.time() - t0 > timeout_s:
+            sup.kill()
+            raise AssertionError(f"[decoupled] timed out after "
+                                 f"{timeout_s}s")
+        w = watermark()
+        if not killed:
+            if w is not None and w >= kill_at:
+                killed_pid = peer_live().get("pid")
+                killed = sigterm_decoupled_child(state, 1)
+                if killed:
+                    window_open = True
+                    print(f"[decoupled] SIGTERM rank 1 (pid "
+                          f"{killed_pid}) at survivor round {w}",
+                          flush=True)
+            continue
+        if window_open:
+            if w is not None and (not samples or w != samples[-1]):
+                samples.append(w)
+            live = peer_live()
+            # The window spans SIGTERM → drain → respawn → re-init:
+            # closed only when a DIFFERENT pid heartbeats "serving"
+            # (the old pid keeps a stale "serving" stamp until its
+            # drain boundary rewrites it).
+            if live.get("status") == "serving" \
+                    and live.get("pid") not in (None, killed_pid) \
+                    and samples:
+                window_open = False
+                print(f"[decoupled] rank 1 back (pid {live.get('pid')},"
+                      f" round {live.get('round')}); survivor "
+                      f"watermark through the window: {samples}",
+                      flush=True)
+            continue
+        if not drained and ledgered("join") == {4, 5, 6, 7}:
+            # Rejoin ledgered on the survivor: the protocol completed
+            # a full leave → restart → rejoin cycle; drain everyone.
+            drained = True
+            print("[decoupled] rejoin ledgered on survivor; draining "
+                  "fleet", flush=True)
+            os.kill(sup.pid, signal.SIGTERM)
+    rc = sup.wait()
+    assert rc == 0, f"[decoupled] supervisor exited rc={rc} " \
+                    f"(logs in {state / 'logs'})"
+    assert killed, "[decoupled] fleet never reached the SIGTERM round"
+    assert not window_open, \
+        "[decoupled] rank 1 never came back serving before the fleet " \
+        "drained"
+    assert drained, \
+        "[decoupled] rank 1's lanes never rejoined on the survivor " \
+        f"(applied joins: {sorted(ledgered('join'))})"
+    assert all(b > a for a, b in zip(samples, samples[1:])), \
+        f"[decoupled] survivor watermark went backwards: {samples}"
+    assert len(samples) >= 3, \
+        f"[decoupled] survivor advanced only {samples} while rank 1 " \
+        "was down — the restart PAUSED the fleet"
+    assert ledgered("leave") == {4, 5, 6, 7}, \
+        "[decoupled] survivor never ledgered rank 1's lanes away"
+
+    finals = {}
+    for rank in (0, 1):
+        finals[rank] = json.loads(
+            (state / f"p{rank}" / "final.json").read_text())
+        assert finals[rank]["round"] >= kill_at, \
+            (rank, finals[rank]["round"])
+        crc = subprocess.run(
+            [sys.executable, "-m", "dopt.obs.check",
+             str(state / f"p{rank}" / "metrics.jsonl"),
+             "--state-dir", str(state / f"p{rank}")],
+            cwd=REPO).returncode
+        assert crc == 0, f"[decoupled] p{rank} stream failed " \
+                         "dopt.obs.check"
+    assert finals[1].get("restarts", 0) >= 1, finals[1].get("restarts")
+    assert finals[0].get("restarts", 0) == 0, finals[0].get("restarts")
+    from dopt.utils.metrics import atomic_write_text
+
+    atomic_write_text(state / "decoupled-report.json", json.dumps({
+        "mode": "decoupled",
+        "survivor_watermark": samples,
+        "final_rounds": {r: finals[r]["round"] for r in (0, 1)},
+        "restarts_p1": finals[1].get("restarts"),
+        "elapsed_s": round(time.time() - t0, 1)}, indent=2))
+    print("decoupled soak passed: one_peer_exp+async fleet trained "
+          "straight through a peer's SIGTERM restart — survivor "
+          f"watermark {samples} (zero paused rounds), peer resumed "
+          f"after {finals[1].get('restarts')} restart(s), lanes left "
+          "and rejoined via liveness", flush=True)
+    return 0
+
+
 def run_long_soak(args, root: Path) -> int:
     """``--minutes N``: the ROADMAP long soak.  One resident daemon
     kept alive for N wall minutes under seeded randomized churn —
@@ -540,7 +726,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.minutes is not None:
         return run_long_soak(args, root)
     if args.fleet:
-        return run_fleet_soak(args, root)
+        rc = run_fleet_soak(args, root)
+        if rc == 0:
+            # The zero-paused-rounds leg rides the same flag: the SPMD
+            # fleet proves bit-exact quiesce-and-resume, the decoupled
+            # fleet proves training THROUGH the restart.
+            rc = run_decoupled_soak(args, root)
+        return rc
     rounds = args.rounds
     attempt = 0
     dir_a = root / "uninterrupted"

@@ -1,0 +1,179 @@
+"""One-peer time-varying topology + asynchronous (staleness-1) gossip.
+
+Engine-level contracts for ``GossipConfig.topology='one_peer_exp'`` and
+``GossipConfig.mixing='async'``: blocked/prefetched/resumed execution is
+bit-identical to the per-round trace (the canonical-stream guarantee
+extended to both new modes), async round 0 coincides with sync round 0
+(round −1's state is the shared init), faults ride the same stateless
+draws, and the composition rules reject the layers a stale mix cannot
+screen.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,
+                         GossipConfig, ModelConfig, OptimizerConfig,
+                         RobustConfig)
+from dopt.engine import GossipTrainer
+
+
+def _cfg(faults=None, iid=True, robust=None, population=None, **g_over):
+    g = dict(algorithm="dsgd", topology="one_peer_exp", mode="metropolis",
+             rounds=4, local_ep=1, local_bs=32)
+    g.update(g_over)
+    return ExperimentConfig(
+        name="t", seed=7,
+        data=DataConfig(dataset="synthetic", num_users=8, iid=iid, shards=2,
+                        synthetic_train_size=512, synthetic_test_size=128),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=0.1, momentum=0.5),
+        faults=faults or FaultConfig(),
+        robust=robust,
+        population=population,
+        gossip=GossipConfig(**g))
+
+
+def _fetch(tr):
+    return jax.tree.map(np.asarray, jax.device_get(tr.params))
+
+
+def _same(a, b):
+    return all(np.array_equal(x, y)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_one_peer_exp_sync_blocked_parity_and_learns(devices):
+    tr = GossipTrainer(_cfg())
+    # n=8: the compiled shift set is the exponential-graph union
+    # {2^0, 2^1, 2^2} plus shift 0 (diagonal + dropout-repair identity).
+    assert tuple(tr._shift_ids) == (0, 1, 2, 4)
+    h = tr.run(rounds=4, block=1)
+    tr2 = GossipTrainer(_cfg())
+    h2 = tr2.run(rounds=4, block=2)
+    assert _same(_fetch(tr), _fetch(tr2)), \
+        "one_peer_exp blocked execution diverged from per-round"
+    assert h.rows == h2.rows
+    accs = [r["avg_test_acc"] for r in h.rows if "avg_test_acc" in r]
+    assert accs[-1] > accs[0], accs
+
+
+def test_async_per_round_blocked_prefetched_parity(devices):
+    tr1 = GossipTrainer(_cfg(mixing="async"))
+    h1 = tr1.run(rounds=4, block=1)
+    tr2 = GossipTrainer(_cfg(mixing="async"))
+    h2 = tr2.run(rounds=4, block=2)
+    tr3 = GossipTrainer(_cfg(mixing="async", prefetch="on"))
+    h3 = tr3.run(rounds=4, block=2)
+    p1, p2, p3 = _fetch(tr1), _fetch(tr2), _fetch(tr3)
+    assert _same(p1, p2), "async blocked diverged from per-round"
+    assert _same(p1, p3), "async prefetched-blocked diverged from per-round"
+    assert h1.rows == h2.rows == h3.rows
+
+
+def test_async_round0_equals_sync_round0(devices):
+    # Round −1's state is defined as the shared init, so the stale read
+    # of round 0 sees exactly what the sync mix sees.
+    ts = GossipTrainer(_cfg())
+    ts.run(rounds=1)
+    ta = GossipTrainer(_cfg(mixing="async"))
+    ta.run(rounds=1)
+    assert _same(_fetch(ts), _fetch(ta))
+
+
+def test_async_dense_path(devices):
+    # comm_impl falls back to the dense all_gather contraction when the
+    # topology has no usable shift union; the diag/off-diag split must
+    # ride it too.
+    tr = GossipTrainer(_cfg(topology="complete", mixing="async"))
+    assert tr._shift_ids is None
+    h = tr.run(rounds=2, block=2)
+    assert len(h.rows) == 2
+
+
+def test_async_resume_bit_exact(devices, tmp_path):
+    ck = os.path.join(tmp_path, "ck")
+    cont = GossipTrainer(_cfg(mixing="async"))
+    cont.run(rounds=4, block=2)
+    part = GossipTrainer(_cfg(mixing="async"))
+    part.run(rounds=2, block=2, checkpoint_every=2, checkpoint_path=ck)
+    res = GossipTrainer(_cfg(mixing="async"))
+    res.restore(ck)
+    assert res.round == 2
+    res.run(rounds=2, block=2)
+    assert _same(_fetch(cont), _fetch(res)), \
+        "async killed-and-resumed run diverged from continuous"
+    assert cont.history.rows == res.history.rows
+
+
+def test_async_restore_requires_prev_buffer(devices, tmp_path):
+    # A sync checkpoint has no staleness-1 buffer; resuming it async
+    # would mix round t against the wrong previous-round snapshot.
+    ck = os.path.join(tmp_path, "ck")
+    sync = GossipTrainer(_cfg())
+    sync.run(rounds=2, checkpoint_every=2, checkpoint_path=ck)
+    res = GossipTrainer(_cfg(mixing="async"))
+    with pytest.raises(ValueError, match="async_prev"):
+        res.restore(ck)
+
+
+def test_async_faults_blocked_parity(devices):
+    # Crash + churn compose with async (the repaired identity row splits
+    # into diag=1/off-diag=0 — a pure local step); the fused scan must
+    # replay the identical storm AND ledger.
+    fc = FaultConfig(crash=0.15, churn=0.1, churn_span=2)
+    t1 = GossipTrainer(_cfg(faults=fc, mixing="async"))
+    t1.run(rounds=4, block=1)
+    t2 = GossipTrainer(_cfg(faults=fc, mixing="async"))
+    t2.run(rounds=4, block=2)
+    assert _same(_fetch(t1), _fetch(t2))
+    assert t1.history.faults == t2.history.faults
+    assert t1.history.faults, "cocktail drew no faults — raise the rates"
+
+
+def test_one_peer_exp_consensus_contracts(devices):
+    # The schedule's per-period product is exact uniform averaging, so
+    # non-IID workers end closer together than under no consensus.
+    tr = GossipTrainer(_cfg(iid=False))
+    tr.run(rounds=4)
+    spread = max(float(np.std(np.asarray(l), axis=0).max())
+                 for l in jax.tree.leaves(tr.params))
+    tr2 = GossipTrainer(_cfg(iid=False, topology="circle",
+                             algorithm="nocons"))
+    tr2.run(rounds=4)
+    spread_no = max(float(np.std(np.asarray(l), axis=0).max())
+                    for l in jax.tree.leaves(tr2.params))
+    assert spread < spread_no
+
+
+def test_one_peer_exp_non_power_of_two_rejected(devices):
+    cfg = dataclasses.replace(
+        _cfg(), data=dataclasses.replace(_cfg().data, num_users=6))
+    with pytest.raises(ValueError, match="power-of-2"):
+        GossipTrainer(cfg)
+
+
+@pytest.mark.parametrize("over, match", [
+    (dict(mixing="asink"), "unknown gossip mixing"),
+    (dict(mixing="async", algorithm="fedlcon", eps=2), "single-sweep"),
+    (dict(mixing="async", correction="push_sum"), "link faults"),
+    (dict(mixing="async", update_sharding="scatter"), "scatter"),
+])
+def test_async_composition_rejections(devices, over, match):
+    with pytest.raises(ValueError, match=match):
+        GossipTrainer(_cfg(**over))
+
+
+def test_async_rejects_link_faults_and_robust(devices):
+    with pytest.raises(ValueError, match="link faults"):
+        GossipTrainer(_cfg(mixing="async",
+                           faults=FaultConfig(msg_drop=0.2)))
+    with pytest.raises(ValueError, match="robust"):
+        GossipTrainer(_cfg(mixing="async",
+                           robust=RobustConfig(clip_radius=1.0)))

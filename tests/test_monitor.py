@@ -588,11 +588,18 @@ def test_regress_committed_trajectory_and_cli(tmp_path):
     entries = read_ledger(ledger)
     assert [e["run_id"] for e in entries][:5] == [f"r{i:02d}"
                                                  for i in range(1, 6)]
-    slow = dict(entries[-1]["bench"])
+    # Windows are keyed (metric, device_kind): the synthetic slowdown
+    # must land in the r01-r05 TPU headline window, not in a fresh
+    # single-entry key like r06's CPU topology-modes ablation (that
+    # one is correctly judged NO_BASELINE).
+    headline = [e for e in entries if e["bench"]["metric"]
+                == "gossip_rounds_per_sec_dsgd_mnist_6workers_model1_bf16"]
+    assert len(headline) >= 5
+    slow = dict(headline[-1]["bench"])
     # -20% against the trailing trimmed MEDIAN (the regressor's
     # baseline), not against the newest point — r05 sits above the
     # median, so scaling it would understate the injected slowdown.
-    med, _, _ = trimmed_stats([e["bench"]["value"] for e in entries])
+    med, _, _ = trimmed_stats([e["bench"]["value"] for e in headline])
     slow["value"] = round(0.8 * med, 4)
     cand = tmp_path / "cand.json"
     cand.write_text(json.dumps(make_entry(slow, run_id="synthetic-20")))

@@ -504,6 +504,98 @@ def test_obs_serve_port0_statefile_sigterm(tmp_path):
             proc.wait()
 
 
+# --------------------------------------------------- decoupled fleets
+
+def test_lanes_of_covers_every_lane_once():
+    assert list(ServeDaemon.lanes_of(0, 2, 8)) == [0, 1, 2, 3]
+    assert list(ServeDaemon.lanes_of(1, 2, 8)) == [4, 5, 6, 7]
+    # uneven split: still a partition, in order
+    cover = [i for r in range(3) for i in ServeDaemon.lanes_of(r, 3, 8)]
+    assert cover == list(range(8))
+
+
+def test_decoupled_and_spmd_mutually_exclusive(tmp_path):
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServeDaemon(tiny_gossip_cfg(), tmp_path, fleet_size=2,
+                    num_processes=2)
+
+
+def test_decoupled_liveness_leave_and_rejoin(tmp_path):
+    """The decoupled control plane, in-process: a drained peer's
+    departure stamp turns its lanes into ledgered auto-leaves at the
+    survivor's next boundary (no timeout wait), and a fresh heartbeat
+    turns them back into joins after the survivor resumes."""
+    fleet = tmp_path
+
+    # Rank 1 runs to drain; _finalize stamps its heartbeat 'drained'.
+    d1 = ServeDaemon(tiny_gossip_cfg(), fleet / "p1", checkpoint_every=0,
+                     max_rounds=2, admin_port=None, fleet_rank=1,
+                     fleet_size=2, fleet_dir=fleet,
+                     peer_timeout_s=60.0).start()
+    assert d1.serve() == 0
+    stamp = json.loads((fleet / "liveness-p1.json").read_text())
+    assert stamp["status"] == "drained" and stamp["rank"] == 1
+
+    # Rank 0 sees the stamp at its first boundary: every rank-1 lane
+    # leaves, ledgered auto like the drop_rate auto-pause.
+    d0 = ServeDaemon(tiny_gossip_cfg(), fleet / "p0", checkpoint_every=2,
+                     max_rounds=2, admin_port=None, fleet_rank=0,
+                     fleet_size=2, fleet_dir=fleet,
+                     peer_timeout_s=60.0).start()
+    assert d0.serve() == 0
+    recs = {r["id"]: r for r in ControlLedger.replay(
+        fleet / "p0" / "applied.jsonl")}
+    for i in (4, 5, 6, 7):
+        rec = recs[f"auto-liveness-leave-r0-w{i}"]
+        assert rec["status"] == "applied" and rec["auto"] is True
+    away = d0.membership.away_at(2, 8)
+    assert list(np.nonzero(away)[0]) == [4, 5, 6, 7]
+    churn = [(r["worker"], r["action"]) for r in d0.trainer.history.faults
+             if r["kind"] == "churn" and r["action"] == "left"]
+    assert {w for w, _ in churn} == {4, 5, 6, 7}
+
+    # Peer comes back (fresh heartbeat, new pid): the resumed rank 0
+    # replays its ledger (lanes still away) and auto-joins them.
+    (fleet / "liveness-p1.json").write_text(json.dumps(
+        {"pid": 999999, "rank": 1, "round": 2, "status": "serving",
+         "ts": time.time()}))
+    d0b = ServeDaemon(tiny_gossip_cfg(), fleet / "p0", checkpoint_every=2,
+                      max_rounds=4, admin_port=None, fleet_rank=0,
+                      fleet_size=2, fleet_dir=fleet,
+                      peer_timeout_s=60.0).start()
+    assert d0b._resumed and d0b.trainer.round == 2
+    assert list(np.nonzero(d0b.membership.away_at(2, 8))[0]) == [4, 5, 6, 7]
+    assert d0b.serve() == 0
+    recs = {r["id"]: r for r in ControlLedger.replay(
+        fleet / "p0" / "applied.jsonl")}
+    for i in (4, 5, 6, 7):
+        assert recs[f"auto-liveness-join-r2-w{i}"]["status"] == "applied"
+    assert not d0b.membership.away_at(4, 8).any()
+
+
+def test_await_directive_timeout_diagnostics(tmp_path):
+    """The follower's directive-barrier timeout names the leader's
+    heartbeat age and the last published directive — the two bits that
+    tell a dead leader from a slow one."""
+    d = ServeDaemon(tiny_gossip_cfg(), tmp_path, admin_port=None,
+                    process_id=1, num_processes=2,
+                    directive_poll_s=0.01, directive_max_polls=4)
+    with pytest.raises(RuntimeError, match="no heartbeat file"):
+        d._await_directive(0, 3)
+    # With a leader heartbeat and a stale published directive, the
+    # error carries both (age + last seq) plus the triage guidance.
+    (tmp_path / "liveness-p0.json").write_text(json.dumps(
+        {"pid": 1, "rank": 0, "round": 7, "status": "serving",
+         "ts": time.time() - 5.0}))
+    (tmp_path / "epoch").mkdir()
+    (tmp_path / "epoch" / "000004-7.json").write_text("{}")
+    with pytest.raises(RuntimeError) as ei:
+        d._await_directive(5, 8)
+    msg = str(ei.value)
+    assert "heartbeat" in msg and "status 'serving'" in msg
+    assert "000004-7" in msg and "leader is gone" in msg
+
+
 # ------------------------------------------------- multi-process legs
 
 @pytest.mark.slow

@@ -248,3 +248,92 @@ def test_hierarchical_validation():
         Topology.hierarchical(9, groups=2)
     with pytest.raises(ValueError):
         Topology.hierarchical(8, groups=2, period=1)
+
+
+def test_one_peer_exp_matrix_invariants():
+    mm = build_mixing_matrices("one_peer_exp", "metropolis", 8)
+    assert len(mm.matrices) == 3  # log2(8) graphs, cycled per round
+    for m in mm.matrices:
+        # dyadic 0.5s sum EXACTLY in binary floating point
+        assert np.all(m.sum(0) == 1.0) and np.all(m.sum(1) == 1.0)
+        # every worker talks to exactly ONE peer: self + one off-diag
+        assert np.all((m != 0).sum(axis=1) == 2)
+        assert np.all(np.diag(m) == 0.5)
+
+
+def test_one_peer_exp_period_product_is_uniform():
+    # The union over a period is the exponential graph; the PRODUCT of
+    # the period's matrices is exact uniform averaging — the finite-time
+    # consensus property that makes one edge per round contract like a
+    # well-connected topology.
+    n = 8
+    mm = build_mixing_matrices("one_peer_exp", "metropolis", n)
+    prod = np.eye(n)
+    for t in range(len(mm.matrices)):
+        prod = mm.for_round(t) @ prod
+    np.testing.assert_allclose(prod, np.ones((n, n)) / n, atol=1e-12)
+
+
+def test_one_peer_exp_validation():
+    with pytest.raises(ValueError, match="power-of-2"):
+        build_mixing_matrices("one_peer_exp", "metropolis", 6)
+    with pytest.raises(ValueError, match="self_weight"):
+        build_mixing_matrices("one_peer_exp", "metropolis", 8,
+                              self_weight=True)
+
+
+def test_schedule_shift_union_one_peer_exp():
+    from dopt.topology import schedule_shift_decomposition
+
+    mm = build_mixing_matrices("one_peer_exp", "metropolis", 8)
+    assert schedule_shift_decomposition(mm) == (0, 1, 2, 4)
+    # extra_shifts forces the dropout-repair identity diagonal into the
+    # compiled set; already present here, so it is a no-op — and
+    # canonicalised mod n, so -1 means the n-1 diagonal.
+    assert schedule_shift_decomposition(mm, extra_shifts=(0,)) == (0, 1, 2, 4)
+    assert schedule_shift_decomposition(mm, extra_shifts=(-1,)) == \
+        (0, 1, 2, 4, 7)
+
+
+def test_schedule_shift_union_bail_never_mutates_extra_shifts():
+    from dopt.topology import schedule_shift_decomposition
+
+    mm = build_mixing_matrices("complete", "metropolis", 8)
+    extra = [0]
+    assert schedule_shift_decomposition(mm, max_shifts=3,
+                                        extra_shifts=extra) is None
+    assert extra == [0], "None bail mutated the caller's extra_shifts"
+
+
+def test_schedule_shift_union_extra_shift_zero_for_repair():
+    from dopt.topology import (coeffs_for_matrix, repair_for_dropout,
+                               schedule_shift_decomposition)
+
+    # Zero-diagonal reference modes have no shift-0 diagonal, but
+    # dropout repair writes identity rows; the engine forces shift 0 so
+    # the repaired matrix stays inside the compiled set.
+    mm = build_mixing_matrices("circle", "stochastic", 8, seed=1)
+    bare = schedule_shift_decomposition(mm)
+    assert 0 not in bare
+    ids = schedule_shift_decomposition(mm, extra_shifts=(0,))
+    assert ids == tuple(sorted({0, *bare}))
+    alive = np.ones(8)
+    alive[3] = 0
+    repaired = repair_for_dropout(mm.matrices[0], alive)
+    coeffs = coeffs_for_matrix(repaired, ids)
+    assert coeffs.shape == (len(ids), 8)
+    with pytest.raises(ValueError):
+        coeffs_for_matrix(repaired, bare)  # identity row not covered
+
+
+def test_schedule_shift_union_dense_fallback():
+    from dopt.topology import schedule_shift_decomposition
+
+    # A time-varying schedule whose UNION collapses to (near-)dense must
+    # bail to the all_gather path even though each round is sparse.
+    mm = build_mixing_matrices("random", "metropolis", 8, p=0.6,
+                               schedule_len=6, seed=2)
+    assert schedule_shift_decomposition(mm, max_shifts=4) is None
+    # and with no budget it returns the full union rather than bailing
+    ids = schedule_shift_decomposition(mm)
+    assert ids is not None and len(ids) > 4
