@@ -64,11 +64,27 @@ aggregation.  Each leg prints its own JSON line with the
 served per second) plus ``population``/``cohort_size``/``waves``
 fields; ``--quick`` emits the 1k line as a CI artifact.
 
+r07: the fused mix+update epilogue lands in the engines.  ``--fused on``
+(default) measures the fast workload twice — ``fused_update`` off vs on,
+both ``update_sharding='off'`` — and folds ``fused_rounds_per_sec`` +
+``fused_speedup`` into the headline line and the ``--quick`` artifact
+(CI asserts present-and-finite).  ``--hbm-reuse-check`` is the donation
+proof: the fused workload at block=1 vs block=4, peak-memory gauge flat
+to ±10% or nonzero exit.  The seqlm workload is promoted to a headline
+leg (``scripts/bench_seqlm.py`` stays the standalone sweep tool): its
+tokens/sec line rides every full run and appends to the ledger under
+its own ``(seqlm_tokens_per_sec, device_kind)`` key.  ``--fused-modes``
+is the standalone r07 mode (the r06 ``--topology-modes`` pattern): the
+fused A/B on the backend-portable MLP gossip workload with the
+hbm-reuse proof folded in, plus the seqlm leg, each appended under its
+own ledger key.
+
 Prints the main JSON line:
   {"metric": "...", "value": N, "unit": "rounds/sec", "vs_baseline": N,
    "conv_fraction": f, "comm_fraction": f, "update_fraction": f,
+   "fused_rounds_per_sec": N, "fused_speedup": N,
    "clients_per_sec_1k": N, "clients_per_sec_10k": N, ...}
-plus one JSON line per client-scale leg.
+plus one JSON line per client-scale leg and one for the seqlm leg.
 """
 
 from __future__ import annotations
@@ -97,7 +113,8 @@ def _device_peak_flops() -> tuple[str, float | None]:
 
 def _config(*, fast: bool, train_size: int, test_size: int,
             faithful_model: bool = True, update_sharding: str = "off",
-            prefetch: str = "off", diagnostics: str = "off"):
+            prefetch: str = "off", diagnostics: str = "off",
+            fused: str = "off"):
     from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
                              ModelConfig, OptimizerConfig)
 
@@ -126,7 +143,8 @@ def _config(*, fast: bool, train_size: int, test_size: int,
                             mode="stochastic", rounds=10, local_ep=4,
                             local_bs=128,
                             update_sharding=update_sharding,
-                            prefetch=prefetch, diagnostics=diagnostics),
+                            prefetch=prefetch, diagnostics=diagnostics,
+                            fused_update=fused),
     )
 
 
@@ -576,6 +594,280 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
     return out
 
 
+def _measure_fused(*, train_size: int, test_size: int, rounds: int,
+                   block: int, repeats: int, faithful_model: bool = True,
+                   prefetch: str = "off", max_spread: float = 0.0,
+                   telemetry=None):
+    """Fused-epilogue A/B on the fast workload: the identical bf16 leg
+    measured with ``GossipConfig.fused_update`` off and on, both with
+    ``update_sharding='off'`` — the fused epilogue replaces the dense
+    consensus contraction, and the scatter path is one of its
+    documented non-compositions (the eligibility matrix row).  The off
+    leg compiles the exact pre-change oracle-parity program; the on leg
+    runs the one-pass ``fused_mix_update`` Pallas epilogue over the
+    restructured (post-mix params, displacement) scan carry.  Returns
+    both rounds/sec plus their ratio (``fused_speedup``) and the fused
+    leg's accuracy — the headline fields the regress ledger tracks."""
+    base = _measure(
+        _config(fast=True, train_size=train_size, test_size=test_size,
+                faithful_model=faithful_model, update_sharding="off",
+                prefetch=prefetch, fused="off"),
+        rounds, block, repeats, max_spread=max_spread, telemetry=telemetry)
+    fused = _measure(
+        _config(fast=True, train_size=train_size, test_size=test_size,
+                faithful_model=faithful_model, update_sharding="off",
+                prefetch=prefetch, fused="on"),
+        rounds, block, repeats, max_spread=max_spread, telemetry=telemetry)
+    return {
+        "fused_rounds_per_sec": round(fused["rounds_per_sec"], 4),
+        "fused_off_rounds_per_sec": round(base["rounds_per_sec"], 4),
+        "fused_speedup": round(fused["rounds_per_sec"]
+                               / base["rounds_per_sec"], 4),
+        "fused_spread_pct": round(fused["spread_pct"], 2),
+        "fused_avg_test_acc": round(fused["avg_test_acc"], 4),
+    }
+
+
+def _fused_modes_config(*, fused: str, train_size: int, test_size: int,
+                        workers: int = 6, rounds: int = 8,
+                        prefetch: str = "off"):
+    """The r07 standalone fused-ablation workload: the hbm-reuse-gate
+    shape (6 worker lanes, MLP, circle topology, metropolis weights)
+    at ledger size.  MLP rather than model1 so the leg is feasible on
+    every backend the ledger sees — model1's grouped conv stack is
+    accelerator-bound, and the fused epilogue's cost model (one pass
+    over the params instead of mix-then-axpy) is architecture-agnostic;
+    the model1 delta rides the full bench's ``--fused`` leg instead."""
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+
+    return ExperimentConfig(
+        name=f"bench-fused-{fused}",
+        seed=2029,
+        data=DataConfig(dataset="synthetic", num_users=workers, iid=True,
+                        synthetic_train_size=train_size,
+                        synthetic_test_size=test_size,
+                        plan_impl="native"),
+        model=ModelConfig(model="mlp", faithful=False,
+                          compute_dtype="bfloat16"),
+        optim=OptimizerConfig(lr=0.05, momentum=0.9),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=rounds,
+                            local_ep=1, local_bs=128, prefetch=prefetch,
+                            fused_update=fused),
+    )
+
+
+def _measure_fused_modes(*, train_size: int, test_size: int, rounds: int,
+                         repeats: int, workers: int = 6, telemetry=None,
+                         prefetch: str = "off", max_spread: float = 0.0,
+                         hbm_rounds: int | None = 8) -> dict:
+    """Standalone r07 mode: the fused-epilogue A/B on the MLP gossip
+    workload, under its own ledger key (same pattern as the r06
+    ``--topology-modes`` leg — a different workload from the model1
+    headline, so the ``(metric, device_kind)`` key keeps the windows
+    separate).  Two legs of the identical blocked run differing ONLY
+    in ``GossipConfig.fused_update``; the headline ``value`` is the
+    fused leg's rounds/sec, with the off leg and their ratio
+    (``fused_speedup``) alongside.  When ``hbm_rounds`` is set the
+    donation proof (block=1 vs block=4 subprocess peaks) is folded
+    into the same entry, so one ledger line carries fused throughput,
+    the speedup, and the HBM-reuse evidence."""
+    kind, _ = _device_peak_flops()
+    legs = {}
+    for name in ("off", "on"):
+        legs[name] = _measure(
+            _fused_modes_config(fused=name, train_size=train_size,
+                                test_size=test_size, workers=workers,
+                                rounds=rounds, prefetch=prefetch),
+            rounds, rounds, repeats, max_spread=max_spread,
+            telemetry=telemetry)
+        print(f"# fused-modes {name}: "
+              f"{legs[name]['rounds_per_sec']:.4f} r/s (spread "
+              f"{legs[name]['spread_pct']:.1f}%, "
+              f"acc={legs[name]['avg_test_acc']:.4f})", file=sys.stderr)
+    base, fused = legs["off"], legs["on"]
+    result = {
+        "metric": f"gossip_fused_epilogue_dsgd_mlp_{workers}workers",
+        "value": round(fused["rounds_per_sec"], 4),
+        "unit": "rounds/sec",
+        "workers": workers,
+        "rounds_per_block": rounds,
+        "device_kind": kind,
+        "prefetch": prefetch,
+        "fused_rounds_per_sec": round(fused["rounds_per_sec"], 4),
+        "fused_off_rounds_per_sec": round(base["rounds_per_sec"], 4),
+        "fused_speedup": round(fused["rounds_per_sec"]
+                               / base["rounds_per_sec"], 4),
+        "fused_spread_pct": round(fused["spread_pct"], 2),
+        "fused_avg_test_acc": round(fused["avg_test_acc"], 4),
+        "fused_off_avg_test_acc": round(base["avg_test_acc"], 4),
+        "spread_pct": round(fused["spread_pct"], 2),
+        "samples_per_sec": round(fused["samples_per_sec"], 1),
+        "host_gap_pct": round(fused["host_gap_pct"], 2),
+    }
+    if hbm_rounds:
+        hbm = _hbm_reuse_measure(rounds=hbm_rounds)
+        result["hbm_reuse_status"] = hbm["status"]
+        for key in ("hbm_peak_bytes_block1", "hbm_peak_bytes_block4",
+                    "growth_pct", "hbm_source"):
+            if key in hbm:
+                result["hbm_reuse_" + key.removeprefix("hbm_")] = hbm[key]
+    return result
+
+
+def _measure_seqlm(*, steps: int, seq_len: int, batch: int, repeats: int,
+                   kv_chunk: int = 0, telemetry=None):
+    """The seqlm headline leg (promoted from ``scripts/bench_seqlm.py``,
+    which stays the standalone sweep tool): steady-state tokens/sec of
+    the ``seqlm`` preset — decoder-only TransformerLM, ring attention,
+    sequence axis sharded over all devices.  Emits the standard
+    bench-line schema so the ledger judges it under its OWN
+    ``(seqlm_tokens_per_sec, device_kind)`` key, separate from the
+    gossip headline windows."""
+    import dataclasses
+
+    import jax
+
+    from dopt.engine import SeqLMTrainer
+    from dopt.presets import get_preset
+
+    cfg = get_preset("seqlm")
+    cfg = cfg.replace(seqlm=dataclasses.replace(
+        cfg.seqlm, steps=steps, seq_len=seq_len, batch=batch,
+        kv_chunk=kv_chunk, log_every=max(steps // 3, 1)))
+    tr = SeqLMTrainer(cfg)
+    tr.run(steps=3)                       # compile + warmup
+    tokens = steps * batch * seq_len
+    tps, total = [], 0.0
+    for _ in range(max(repeats, 1)):
+        t0 = time.time()
+        tr.run(steps=steps)
+        jax.block_until_ready(tr.params)
+        elapsed = time.time() - t0
+        total += elapsed
+        tps.append(tokens / elapsed)
+    med, spread, _ = _trimmed_stats(tps)
+    out = {
+        "metric": "seqlm_tokens_per_sec",
+        "value": round(med, 1),
+        "unit": "tokens/sec",
+        "device_kind": str(jax.devices()[0].device_kind),
+        "spread_pct": round(spread, 2),
+        "measured_windows": len(tps),
+        "measured_seconds": round(total, 2),
+        "steps_per_window": steps,
+        "attn": cfg.seqlm.attn,
+        "seq_len": seq_len,
+        "batch": batch,
+        "kv_chunk": kv_chunk,
+        "mesh_devices": tr.mesh.size,
+        "params": tr.param_count,
+        "final_loss": round(tr.history.last()["loss"], 4),
+    }
+    from dopt.utils.profiling import device_memory_stats
+
+    mem = device_memory_stats()
+    if mem is not None:
+        out["hbm_peak_gb"] = round(mem["peak_bytes"] / 2**30, 3)
+        out["hbm_source"] = mem["source"]
+    if telemetry is not None:
+        from dopt.obs.events import sanitize_metrics
+
+        telemetry.emit("bench", metrics=sanitize_metrics(out))
+    return out
+
+
+def _hbm_reuse_point(block: int, rounds: int) -> None:
+    """(internal, spawned by ``--hbm-reuse-check``) Run the fused
+    gossip workload at ONE block size in THIS process and print its
+    peak-memory gauge — per-process peaks are comparable; a shared
+    process would see only the running maximum."""
+    from dopt.config import (DataConfig, ExperimentConfig, GossipConfig,
+                             ModelConfig, OptimizerConfig)
+    from dopt.engine import GossipTrainer
+    from dopt.utils.profiling import device_memory_stats
+
+    import jax
+
+    cfg = ExperimentConfig(
+        name=f"hbm-reuse-b{block}", seed=7,
+        data=DataConfig(dataset="synthetic", num_users=6,
+                        synthetic_train_size=1_536,
+                        synthetic_test_size=256),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=0.9),
+        gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                            mode="metropolis", rounds=rounds,
+                            local_ep=1, local_bs=128,
+                            fused_update="on"))
+    tr = GossipTrainer(cfg, eval_every=10 * rounds + 97)
+    tr.run(rounds=rounds, block=block)
+    jax.block_until_ready(tr.params)
+    mem = device_memory_stats()
+    print(json.dumps({
+        "block": block,
+        "hbm_peak_bytes": None if mem is None else int(mem["peak_bytes"]),
+        "hbm_source": None if mem is None else mem["source"],
+    }))
+
+
+def _hbm_reuse_measure(*, rounds: int = 8,
+                       tolerance_pct: float = 10.0) -> dict:
+    """Measure the donation proof: run the fused-epilogue workload
+    per-round (block=1) and blocked (block=4), each in its OWN
+    subprocess (per-process peaks are comparable; a shared process
+    would see only the running maximum), and compare the peak-memory
+    gauges.  Returns the verdict dict — ``status`` is ``ok`` when the
+    block=4 peak is flat to ±``tolerance_pct``, ``FAIL`` on growth or
+    a failed point run, ``skipped`` when the backend has no gauge."""
+    import subprocess
+
+    peaks, src = {}, None
+    for block in (1, 4):
+        cmd = [sys.executable, __file__, "--hbm-reuse-point", str(block),
+               "--rounds", str(rounds)]
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if r.returncode != 0 or line is None:
+            return {"check": "hbm_reuse", "status": "FAIL",
+                    "reason": f"block={block} point run failed",
+                    "stderr_tail": r.stderr.strip()[-400:]}
+        p = json.loads(line)
+        if p["hbm_peak_bytes"] is None:
+            return {"check": "hbm_reuse", "status": "skipped",
+                    "reason": "no memory gauge on this backend"}
+        peaks[block] = int(p["hbm_peak_bytes"])
+        src = p["hbm_source"]
+    growth = 100.0 * (peaks[4] - peaks[1]) / peaks[1]
+    return {
+        "check": "hbm_reuse",
+        "status": "ok" if growth <= tolerance_pct else "FAIL",
+        "hbm_peak_bytes_block1": peaks[1],
+        "hbm_peak_bytes_block4": peaks[4],
+        "growth_pct": round(growth, 2),
+        "tolerance_pct": tolerance_pct,
+        "rounds": rounds,
+        "hbm_source": src,
+    }
+
+
+def _hbm_reuse_check(*, rounds: int = 8, tolerance_pct: float = 10.0) -> int:
+    """The donation proof the CI quick job asserts (hbm-reuse gate):
+    peak bytes must not scale with block length.  Round-carry donation
+    through the blocked ``lax.scan`` (params/momentum/displacement
+    donated into each round and each block dispatch) is what keeps the
+    blocked program at one resident carry; a donation regression shows
+    up here as the block=4 peak growing past the gate.  Prints one
+    JSON verdict line; returns a process exit code (0 flat/skipped,
+    1 regressed/failed)."""
+    res = _hbm_reuse_measure(rounds=rounds, tolerance_pct=tolerance_pct)
+    print(json.dumps(res))
+    return 0 if res["status"] in ("ok", "skipped") else 1
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -622,6 +914,33 @@ def main() -> None:
                          "'off' by construction.  The faithful f32 leg "
                          "always runs 'off' (the oracle-parity host "
                          "loop)")
+    ap.add_argument("--fused", choices=("on", "off"), default="on",
+                    help="measure the fused-epilogue A/B leg (the fast "
+                         "workload with GossipConfig.fused_update off vs "
+                         "on, both update_sharding='off'): emits "
+                         "fused_rounds_per_sec + fused_speedup into the "
+                         "headline JSON line and the --quick CI "
+                         "artifact; 'off' skips the pair")
+    ap.add_argument("--skip-seqlm", action="store_true",
+                    help="skip the seqlm headline leg (ring-attention "
+                         "TransformerLM tokens/sec — its own JSON line "
+                         "and its own (metric, device_kind) ledger key)")
+    ap.add_argument("--seqlm-steps", type=int, default=None,
+                    help="seqlm leg: steps per measured window "
+                         "(default 30, smoke 4)")
+    ap.add_argument("--seqlm-seq-len", type=int, default=None,
+                    help="seqlm leg: sequence length "
+                         "(default 2048, smoke 256)")
+    ap.add_argument("--hbm-reuse-check", action="store_true",
+                    help="run ONLY the donation proof: fused workload "
+                         "at block=1 vs block=4 (subprocess each), "
+                         "assert peak memory flat to +-10%% — exits "
+                         "nonzero on growth (the CI hbm-reuse gate)")
+    ap.add_argument("--hbm-reuse-point", type=int, default=None,
+                    metavar="BLOCK",
+                    help="(internal) one --hbm-reuse-check subprocess "
+                         "point: run the fused workload at this block "
+                         "size and print the peak-memory gauge")
     ap.add_argument("--skip-diagnostics", action="store_true",
                     help="skip the diagnostics-overhead leg (the fast "
                          "workload re-measured with GossipConfig."
@@ -658,6 +977,12 @@ def main() -> None:
                          "append its own headline to the history ledger")
     ap.add_argument("--skip-topology", action="store_true",
                     help="skip the topology-modes legs in the full bench")
+    ap.add_argument("--fused-modes", action="store_true",
+                    help="run ONLY the r07 fused-epilogue ablation "
+                         "(fused_update off vs on on the MLP gossip "
+                         "workload, plus the hbm-reuse donation proof "
+                         "and the seqlm leg) and append their headlines "
+                         "to the history ledger")
     ap.add_argument("--run-id", default=None,
                     help="ledger run id for the history append "
                          "(default: derived from sha + timestamp)")
@@ -668,6 +993,12 @@ def main() -> None:
                          "architecture; same JSON fields, metric suffixed "
                          "_idiomatic")
     args = ap.parse_args()
+
+    if args.hbm_reuse_point is not None:
+        _hbm_reuse_point(args.hbm_reuse_point, args.rounds or 8)
+        return
+    if args.hbm_reuse_check:
+        sys.exit(_hbm_reuse_check(rounds=args.rounds or 8))
 
     if args.update_sharding == "scatter":
         # XLA reads its flags at backend init: arm the latency-hiding
@@ -728,6 +1059,47 @@ def main() -> None:
         _finish_telemetry(result)
         return
 
+    if args.fused_modes:
+        # Standalone r07 mode: the fused-epilogue ablation + the seqlm
+        # headline only, each under its own ledger key.  Mirrors the
+        # r06 --topology-modes pattern — the MLP A/B workload is
+        # backend-portable, so the fused/donation/seqlm windows can be
+        # seeded from any box while the model1 headline waits for a
+        # real accelerator run.
+        f_rounds = args.rounds or (3 if args.smoke else 8)
+        f_repeats = 2 if args.smoke else args.repeats
+        tsize, esize = (4_096, 512) if args.smoke else (16_384, 2_048)
+        result = _measure_fused_modes(
+            train_size=tsize, test_size=esize, rounds=f_rounds,
+            repeats=f_repeats, telemetry=tele, prefetch=args.prefetch,
+            max_spread=0.0 if args.smoke else args.max_spread,
+            hbm_rounds=None if args.smoke else 8)
+        print(json.dumps(result))
+        seqlm = None
+        if not args.skip_seqlm:
+            seqlm = _measure_seqlm(
+                steps=args.seqlm_steps or (4 if args.smoke else 12),
+                seq_len=args.seqlm_seq_len or (256 if args.smoke else 1_024),
+                batch=2 if args.smoke else 4,
+                repeats=1 if args.smoke else min(args.repeats, 3),
+                telemetry=tele)
+            print(json.dumps(seqlm))
+        if args.history_out and not args.smoke:
+            try:
+                from dopt.obs.regress import append_entry
+
+                for line in filter(None, (result, seqlm)):
+                    entry = append_entry(args.history_out, line,
+                                         run_id=args.run_id)
+                    print(f"# appended run {entry['run_id']} "
+                          f"({line['metric']}) to {args.history_out}",
+                          file=sys.stderr)
+            except OSError as e:
+                print(f"# bench history append failed: {e}",
+                      file=sys.stderr)
+        _finish_telemetry(result)
+        return
+
     if args.quick:
         # CI-artifact mode: tiny data, two measured rounds per path —
         # enough to exercise both execution paths end to end and emit
@@ -760,6 +1132,16 @@ def main() -> None:
             # CPU CI runner) — the other half of the CI gate.
             quick_line["hbm_peak_gb"] = round(mem["peak_bytes"] / 2**30, 3)
             quick_line["hbm_source"] = mem["source"]
+        if args.fused == "on":
+            # Fused-epilogue A/B on tiny data: both execution paths end
+            # to end, so the quick artifact always carries finite
+            # fused_rounds_per_sec + fused_speedup fields (the CI
+            # present-and-finite assertion); the VALUES are only
+            # meaningful from the full bench.
+            quick_line.update(_measure_fused(
+                train_size=1_536, test_size=512,
+                rounds=args.rounds or 2, block=args.rounds or 2,
+                repeats=2, prefetch=args.prefetch, telemetry=tele))
         print(json.dumps(quick_line))
         if not args.skip_clients:
             # Client-scale quick line: the 1k-client baseline3 cohort
@@ -877,6 +1259,20 @@ def main() -> None:
               f"off {fast['rounds_per_sec']:.4f} r/s "
               f"({result['diagnostics_overhead_pct']:+.2f}% overhead)",
               file=sys.stderr)
+    if args.fused == "on":
+        # Fused-epilogue headline (ROADMAP raw-speed lever 3 landing):
+        # the identical workload with the round epilogue as ONE
+        # fused_mix_update pass vs the two-op reference — the ratio is
+        # the ledger-tracked fused_speedup.
+        fusedm = _measure_fused(
+            train_size=train_size, test_size=test_size, rounds=rounds,
+            block=block, repeats=repeats, faithful_model=faithful_model,
+            prefetch=args.prefetch, max_spread=max_spread, telemetry=tele)
+        result.update(fusedm)
+        print(f"# fused epilogue: on {fusedm['fused_rounds_per_sec']:.4f} "
+              f"r/s vs off {fusedm['fused_off_rounds_per_sec']:.4f} r/s "
+              f"({fusedm['fused_speedup']:.2f}x; "
+              f"acc={fusedm['fused_avg_test_acc']:.4f})", file=sys.stderr)
     if not args.skip_chaos:
         # Second headline: the degraded-network cocktail at blocked
         # (fused-scan) speed, with the pre-change per-round path timed
@@ -942,6 +1338,23 @@ def main() -> None:
               f"{faith['measured_seconds']:.2f}s (median, spread "
               f"{faith['spread_pct']:.1f}%; acc={faith['avg_test_acc']:.4f}, "
               f"{faith['samples_per_sec']:,.0f} samples/s)", file=sys.stderr)
+    seqlm = None
+    if not args.skip_seqlm:
+        # seqlm headline leg (promoted from scripts/bench_seqlm.py):
+        # its own JSON line and its own ledger entry, judged under the
+        # (seqlm_tokens_per_sec, device_kind) key — a first-seen key
+        # reports NO_BASELINE until its window fills.
+        seqlm = _measure_seqlm(
+            steps=args.seqlm_steps or (4 if args.smoke else 30),
+            seq_len=args.seqlm_seq_len or (256 if args.smoke else 2_048),
+            batch=2 if args.smoke else 8,
+            repeats=1 if args.smoke else min(repeats, 3),
+            telemetry=tele)
+        print(f"# seqlm: {seqlm['value']:,.1f} tokens/s "
+              f"(seq_len={seqlm['seq_len']}, batch={seqlm['batch']}, "
+              f"{seqlm['mesh_devices']} device(s), "
+              f"loss={seqlm['final_loss']:.4f})", file=sys.stderr)
+        print(json.dumps(seqlm))
     print(f"# fast bf16: {repeats}x{rounds} rounds in "
           f"{fast['measured_seconds']:.2f}s (median, spread "
           f"{fast['spread_pct']:.1f}%; acc={fast['avg_test_acc']:.4f}, "
@@ -960,6 +1373,12 @@ def main() -> None:
             print(f"# appended run {entry['run_id']} "
                   f"(sha {entry['git_sha'] or 'unknown'}) to "
                   f"{args.history_out}", file=sys.stderr)
+            if seqlm is not None:
+                s_entry = append_entry(args.history_out, seqlm,
+                                       run_id=args.run_id)
+                print(f"# appended run {s_entry['run_id']} "
+                      f"({s_entry['metric']}) to {args.history_out}",
+                      file=sys.stderr)
         except OSError as e:
             print(f"# bench history append failed: {e}", file=sys.stderr)
     _finish_telemetry(result)

@@ -177,6 +177,23 @@ class FederatedConfig:
     # bucket): small enough that several collectives are in flight for
     # the scheduler to overlap, large enough to amortise collective
     # launch overhead.
+    fused_update: str = "off"
+    # "off" | "on".  "on" restructures the full-width round carry so
+    # the aggregation epilogue (masked average of the survivors' new
+    # params) runs as ONE fused Pallas pass over the flat-bucket
+    # UpdateShardSpec layout (``dopt.ops.fused_mix_update``): the carry
+    # holds theta BROADCAST over the worker axis, each round contracts
+    # the masked per-lane displacements (p_i − theta) with the
+    # mean-weight matrix and adds theta back in the same HBM pass —
+    # equal to the jnp masked_average path to f32 summation order
+    # (allclose, not bit-equal), and fused-vs-fused runs are
+    # bit-reproducible, blocked-exact and resume-exact.  "off" (the
+    # default) compiles the exact pre-change programs (fingerprint-
+    # gated, bit-identical).  fedavg/fedprox full-width mean only:
+    # rejected (loudly) with scaffold/fedadmm, staleness-aware
+    # aggregation, robust aggregators, clip_radius, corrupt faults,
+    # compact gather, update_sharding='scatter', comm_dtype,
+    # population mode, and multi-device meshes.
     prefetch: str = "off"
     # "off" | "on".  "on" overlaps the host pipeline with device
     # compute on the blocked/chaos-blocked/population run loops: block
@@ -357,6 +374,28 @@ class GossipConfig:
     update_bucket_mb: float = 4.0
     # Scatter-mode bucket size bound (per-worker payload MB per
     # bucket); see FederatedConfig.update_bucket_mb.
+    fused_update: str = "off"
+    # "off" | "on".  "on" restructures the gossip scan carry into
+    # (post-mix params, displacement buffer) so the round's consensus
+    # epilogue runs as ONE fused Pallas pass over the flat-bucket
+    # UpdateShardSpec layout (``dopt.ops.fused_mix_update``): the mix
+    # contracts the PREVIOUS round's pre-update params with W and
+    # applies the buffered local displacement in the same HBM pass
+    # (q_t = W·q_{t-1} − fbuf, fbuf = q_{t-1} − p'_{t-1}).  This is
+    # the D-PSGD update ordering (Lian et al., arXiv:1705.09056: the
+    # local displacement is applied UNMIXED after the contraction) — a
+    # documented variant of the default mix-then-step trajectory, NOT
+    # bit-equal to it; the fused trajectory is pinned f32-allclose to
+    # its own jnp reference (``dopt.ops.mix_sgd_reference``) and
+    # fused-vs-fused runs are bit-reproducible, blocked-exact and
+    # resume-exact (the displacement buffer rides the scan carry and
+    # the checkpoint as "fused_buf").  "off" (the default) compiles
+    # the exact pre-change programs (fingerprint-gated,
+    # bit-identical).  dsgd/gossip dense single-sweep consensus only:
+    # rejected (loudly) with the robust layer, link faults/push-sum,
+    # mixing='async', choco, fedlcon eps sweeps, nocons/centralized,
+    # update_sharding='scatter', comm_dtype, comm_impl='shift',
+    # population mode, and multi-device meshes.
     prefetch: str = "off"
     # "off" | "on".  "on" overlaps the host pipeline with device
     # compute on the blocked run loops (clean, link-mode and

@@ -507,6 +507,105 @@ class FederatedTrainer:
             if f.algorithm == "scaffold" else None
         )
 
+        # Fused mean+update epilogue (FederatedConfig.fused_update): the
+        # masked average and the theta update land in ONE Pallas pass
+        # over the flat buckets —  θ'_b = M(mask)·disp + θ_b  with
+        # M(mask) the masked-mean contraction matrix
+        # (dopt.parallel.collectives.mean_weight_matrix) and disp the
+        # masked lane displacements p_t − θ.  Every output row is the
+        # same new theta, so the carried ``self.theta`` HOLDS the
+        # [W, ...] broadcast slab (rows bit-identical; row 0 is the
+        # global model).  Equals masked_average to f32 reassociation —
+        # the allclose, not bit-equal, contract.  "off" (default)
+        # python-gates every use below and compiles the exact
+        # pre-change programs.
+        if f.fused_update not in ("off", "on"):
+            raise ValueError(
+                f"unknown fused_update {f.fused_update!r}; one of off|on")
+        self._fused_on = f.fused_update == "on"
+        if self._fused_on:
+            if f.algorithm not in ("fedavg", "fedprox"):
+                raise ValueError(
+                    "fused_update='on' fuses the masked-mean contraction "
+                    f"with the theta update; algorithm {f.algorithm!r} "
+                    "carries companion state (SCAFFOLD controls / ADMM "
+                    "duals) through the aggregate, which the fused "
+                    "epilogue does not yet speak (fedavg|fedprox)")
+            if aggregator != "mean":
+                raise ValueError(
+                    "fused_update='on' only applies to the masked-mean "
+                    f"reduce; aggregator={aggregator!r} is a full-"
+                    "precision robust contraction with no mixing-matrix "
+                    "form — drop one of the two")
+            if clip_radius > 0:
+                raise ValueError(
+                    "fused_update='on' does not compose with "
+                    "RobustConfig.clip_radius (the ball projection "
+                    "applies per lane BETWEEN the local step and the "
+                    "mean, so the displacement contraction would skip "
+                    "it) — drop one of the two")
+            if has_corrupt:
+                raise ValueError(
+                    "fused_update='on' does not compose with corrupt "
+                    "faults (the Byzantine injection rewrites lane "
+                    "updates between the local step and the aggregate; "
+                    "the robust defenses that make that meaningful are "
+                    "unfused) — drop one of the two")
+            if f.staleness_max > 0:
+                raise ValueError(
+                    "fused_update='on' does not compose with staleness-"
+                    "aware aggregation (the admit-weighted sum over the "
+                    "late buffer is not a masked mean) — drop one of "
+                    "the two")
+            if self._scatter:
+                raise ValueError(
+                    "update_sharding='scatter' already restructures the "
+                    "aggregation hot path; fused_update='on' is the "
+                    "single-device fusion of the same epilogue — drop "
+                    "one of the two")
+            if f.comm_dtype:
+                raise ValueError(
+                    "comm_dtype wire compression only applies to the "
+                    "plain masked-average collective; the fused "
+                    "epilogue contracts at f32 in one HBM pass — drop "
+                    "one of the two")
+            if f.compact:
+                raise ValueError(
+                    "FederatedConfig.compact=True is incompatible with "
+                    "fused_update='on': the fused epilogue contracts "
+                    "the full [W, ...] slab (compact's gathered-lane "
+                    "mean has no fixed-width contraction) — drop one "
+                    "of the two")
+            if self._registry is not None:
+                raise ValueError(
+                    "fused_update='on' does not compose with population "
+                    "mode (waves accumulate into an f32 lane "
+                    "accumulator, not a masked mean over the carried "
+                    "slab) — drop one of the two")
+            if self.mesh.size > 1:
+                raise ValueError(
+                    "fused_update='on' needs a single-device worker "
+                    f"mesh (got {self.mesh.shape}): the Pallas epilogue "
+                    "contracts the full worker axis in one kernel call; "
+                    "multi-device meshes keep the dense or scatter "
+                    "paths")
+            # theta becomes the worker-axis broadcast slab from
+            # CONSTRUCTION, so the first jitted round sees the slab
+            # type/sharding every later round produces.
+            self.theta = shard_worker_tree(stacked, self.mesh)
+        fused_on = self._fused_on
+        self._fused_spec = (
+            make_update_shard_spec(
+                stacked, fold=self.mesh.size,
+                bucket_bytes=int(f.update_bucket_mb * (1 << 20)))
+            if self._fused_on else None)
+        fused_spec = self._fused_spec
+        if self._fused_on:
+            from dopt.ops.fused_update import fused_mix_update
+            from dopt.parallel.collectives import mean_weight_matrix
+        else:
+            fused_mix_update = mean_weight_matrix = None
+
         local_algorithm = {"fedavg": "sgd", "fedprox": "fedprox",
                            "fedadmm": "fedadmm", "scaffold": "scaffold"}[f.algorithm]
         # Grouped stacked-forward fast path (see gossip.py / zoo.py).
@@ -797,7 +896,14 @@ class FederatedTrainer:
                      bweight, train_x, train_y, ex, ey, ew, tidx, tweight,
                      vidx, vw, cmask=None, load_mask=None, stale_p=None,
                      admit_w=None, capture=None):
-            theta_b = broadcast_to_workers(theta, w)
+            if fused_on:
+                # ``theta`` carries the [W, ...] broadcast slab (rows
+                # bit-identical); consumers of the single global model
+                # read row 0.
+                theta_b, theta = theta, jax.tree.map(lambda x: x[0],
+                                                     theta)
+            else:
+                theta_b = broadcast_to_workers(theta, w)
             # Staleness runs load theta into every lane that TRAINS this
             # round (the sampled aggregators AND the captured late
             # senders); only `mask` lanes enter the immediate aggregate.
@@ -883,7 +989,24 @@ class FederatedTrainer:
                 new_stale = _where_mask(capture, p_t, stale_p)
                 stale_scr = (admit_w > 0).astype(jnp.float32) * (1.0 - fin_s)
             else:
-                if agg_robust is not None:
+                if fused_on:
+                    # ONE HBM pass over the flat buckets: masked-mean
+                    # contraction + theta update fuse —
+                    # θ'_b = M(agg_mask)·disp + θ_b, every row the new
+                    # theta.  disp is masked (not just weighted) to
+                    # zero: a screened lane's NaN would poison the
+                    # contraction through 0·NaN otherwise.  An all-dead
+                    # round has M = 0, so θ_b passes through exactly —
+                    # no extra where needed.
+                    disp = _where_mask(
+                        agg_mask,
+                        jax.tree.map(lambda a, b: a - b, p_t, theta_b),
+                        jax.tree.map(jnp.zeros_like, p_t))
+                    theta_slab = fused_mix_update(
+                        disp, theta_b, mean_weight_matrix(agg_mask),
+                        fused_spec, lr=-1.0)
+                    new_theta = jax.tree.map(lambda x: x[0], theta_slab)
+                elif agg_robust is not None:
                     new_theta = agg_robust(agg_in, agg_mask)
                 elif scatter_spec is not None:
                     new_theta = masked_average_scatter(
@@ -896,9 +1019,13 @@ class FederatedTrainer:
                 new_stale, stale_scr = None, None
             # A round with zero surviving (unscreened) updates leaves
             # the global model unchanged (the aggregate over zero
-            # survivors would otherwise zero theta).
-            new_theta = jax.tree.map(
-                lambda a, th: jnp.where(alive_any, a, th), new_theta, theta)
+            # survivors would otherwise zero theta).  The fused slab
+            # already passes theta through (M = 0) and must not meet
+            # the single-tree where.
+            if not fused_on:
+                new_theta = jax.tree.map(
+                    lambda a, th: jnp.where(alive_any, a, th), new_theta,
+                    theta)
             lane_loss = losses.mean(axis=1)
             lane_loss = jnp.where(jnp.isfinite(lane_loss), lane_loss, 0.0)
             local_loss = ((lane_loss * agg_mask).sum()
@@ -919,6 +1046,10 @@ class FederatedTrainer:
             out = finish(new_theta, new_p, new_m, new_duals, new_c,
                          local_loss, em, screened, train_x, train_y, ex,
                          ey, ew, tidx, tweight, stale_scr, diag)
+            if fused_on:
+                # Carry position 0 is the slab; eval/diag above consumed
+                # its row 0.
+                return (theta_slab, *out[1:])
             if has_stale:
                 return (*out[:5], new_stale, out[5])
             return out
@@ -1047,7 +1178,12 @@ class FederatedTrainer:
                           local_loss, em, 1.0 - fin, train_x, train_y, ex,
                           ey, ew, tidx, tweight, diag=diag)
 
-        self._round_fn = jax.jit(round_fn, donate_argnums=(1, 2, 3))
+        # Fused runs additionally donate the theta slab (arg 0): the
+        # kernel aliases θ_b's pages into the new slab, so the
+        # restructured carry costs zero extra HBM.  Off-path jit params
+        # — and therefore the fingerprinted programs — are unchanged.
+        _theta_donate = (0, 1, 2, 3) if fused_on else (1, 2, 3)
+        self._round_fn = jax.jit(round_fn, donate_argnums=_theta_donate)
         self._compact_fn = jax.jit(compact_round_fn, donate_argnums=(1, 2, 3))
 
         def make_block_fn(one_round, with_valid=False):
@@ -1095,7 +1231,7 @@ class FederatedTrainer:
                     tuple(xs))
                 return (*carry, packed)
 
-            return jax.jit(block_fn, donate_argnums=(1, 2, 3))
+            return jax.jit(block_fn, donate_argnums=_theta_donate)
 
         self._block_fn = make_block_fn(round_fn)
         self._compact_block_fn = make_block_fn(compact_round_fn)
@@ -1610,6 +1746,11 @@ class FederatedTrainer:
             # The sharded-update reduce is a full-width collective over
             # the worker axis; compact's gathered-lane mean has nothing
             # to shard (explicit compact=True was rejected at init).
+            return False
+        if self._fused_on:
+            # The fused epilogue contracts the full [W, ...] slab —
+            # compact's gathered-lane mean has nothing to contract
+            # (explicit compact=True was rejected at init).
             return False
         if self._has_stale:
             # The staleness path needs full-width lanes: captured late
@@ -2549,8 +2690,16 @@ class FederatedTrainer:
 
         from dopt.obs import consensus_distance
 
-        cd = consensus_distance(self.params, self.theta)
+        cd = consensus_distance(self.params, self._theta_single())
         return cd if math.isfinite(cd) else None
+
+    def _theta_single(self):
+        """The single global model: row 0 of the carried [W, ...] slab
+        under ``fused_update='on'`` (rows are bit-identical by the
+        fused epilogue's contract), the replicated tree otherwise."""
+        if self._fused_on:
+            return jax.tree.map(lambda x: x[0], self.theta)
+        return self.theta
 
     def _run_summary_telemetry(self) -> None:
         """End-of-``run()`` consensus-distance gauge — one fetch per
@@ -2597,7 +2746,13 @@ class FederatedTrainer:
     def _save(self, path) -> None:
         from dopt.utils.checkpoint import save_checkpoint
 
-        arrays = {"theta": self.theta, "params": self.params}
+        # Fused runs carry theta as the [W, ...] broadcast slab with
+        # bit-identical rows — checkpoint row 0 (the single global
+        # model), so fused and unfused checkpoints stay interchangeable
+        # and W×|θ| never hits disk.
+        theta_ck = (jax.tree.map(lambda x: x[0], self.theta)
+                    if self._fused_on else self.theta)
+        arrays = {"theta": theta_ck, "params": self.params}
         if self.cfg.federated.algorithm != "scaffold":
             # Scaffold momentum is per-round-local (always zeros between
             # rounds) — no point persisting a model-sized zero tree.
@@ -2645,7 +2800,19 @@ class FederatedTrainer:
                 f"{self.cfg.federated.algorithm} trainer requires its "
                 "worker-stacked companion state ('duals') in the checkpoint"
             )
-        self.theta = jax.device_put(arrays["theta"], self._replicated)
+        if self._fused_on:
+            # Re-broadcast the checkpointed single theta onto the
+            # worker-axis slab (rows are bit-identical by the fused
+            # epilogue's contract, so this is resume-exact).
+            self.theta = shard_worker_tree(
+                jax.tree.map(
+                    lambda x: np.ascontiguousarray(np.broadcast_to(
+                        np.asarray(x)[None],
+                        (self.num_workers,) + np.asarray(x).shape)),
+                    arrays["theta"]),
+                self.mesh)
+        else:
+            self.theta = jax.device_put(arrays["theta"], self._replicated)
         self.params = shard_worker_tree(arrays["params"], self.mesh)
         if "momentum" in arrays:
             self.momentum = shard_worker_tree(arrays["momentum"], self.mesh)
@@ -2695,5 +2862,5 @@ class FederatedTrainer:
             self._registry.load_state(state)
 
     def evaluate_global(self) -> dict[str, float]:
-        out = self._global_eval(self.theta, *self._eval)
+        out = self._global_eval(self._theta_single(), *self._eval)
         return {k: float(v) for k, v in out.items()}

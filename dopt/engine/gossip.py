@@ -780,6 +780,98 @@ class GossipTrainer:
         self._async_prev: object = (
             shard_worker_tree(stacked, self.mesh) if self._async else {})
 
+        # Fused mix+update epilogue (GossipConfig.fused_update): the
+        # round's consensus contraction and the previous round's local
+        # displacement land in ONE Pallas pass over the flat-bucket
+        # UpdateShardSpec layout —  q_t = W_t·q_{t-1} − fbuf_{t-1}  with
+        # fbuf_{t-1} = q_{t-1} − p'_{t-1}  carried engine state (the
+        # D-PSGD update ordering, arXiv:1705.09056: the local step folds
+        # in UNMIXED, so the trajectory is a documented variant of —
+        # allclose to, not bit-equal with — the default mix(p')
+        # ordering).  "off" (default) python-gates every use below and
+        # compiles the exact pre-change programs.
+        if g.fused_update not in ("off", "on"):
+            raise ValueError(
+                f"unknown fused_update {g.fused_update!r}; one of off|on")
+        self._fused_on = g.fused_update == "on"
+        if self._fused_on:
+            if g.algorithm not in ("dsgd", "gossip"):
+                raise ValueError(
+                    "fused_update='on' fuses the single dense consensus "
+                    f"sweep with the update; algorithm {g.algorithm!r} "
+                    "has no such sweep to fuse (dsgd|gossip: fedlcon's "
+                    "eps sweeps re-enter the matrix, choco exchanges "
+                    "compressed deltas, nocons/centralized never mix)")
+            if robust_active:
+                raise ValueError(
+                    "fused_update='on' does not compose with the robust "
+                    "layer (corrupt faults / clip_radius / quarantine "
+                    "screen the wire BEFORE mixing; the fused epilogue "
+                    "contracts the carried state directly) — drop one "
+                    "of the two")
+            if self._link_mode:
+                raise ValueError(
+                    "fused_update='on' does not compose with link "
+                    "faults / push-sum (the per-staleness [D+1, n, n] "
+                    "contraction carries its own mass/staleness "
+                    "buffers) — drop one of the two")
+            if self._async:
+                raise ValueError(
+                    "fused_update='on' does not compose with "
+                    "mixing='async' (the staleness-1 diag/off-diag "
+                    "split reads two source trees; the fused "
+                    "contraction reads one) — drop one of the two")
+            if g.update_sharding == "scatter":
+                raise ValueError(
+                    "update_sharding='scatter' already restructures the "
+                    "consensus/update hot path; fused_update='on' is "
+                    "the single-device fusion of the same epilogue — "
+                    "drop one of the two")
+            if g.comm_dtype:
+                raise ValueError(
+                    "comm_dtype wire compression only applies to the "
+                    "plain consensus collectives; the fused epilogue "
+                    "contracts at f32 in one HBM pass — drop one of "
+                    "the two")
+            if g.comm_impl == "shift":
+                raise ValueError(
+                    "comm_impl='shift' is incompatible with "
+                    "fused_update='on': the fused epilogue is one dense "
+                    "[n, n] contraction, and the ppermute shift "
+                    "decomposition has no single-pass fused form")
+            if cfg.population is not None:
+                raise ValueError(
+                    "fused_update='on' does not compose with population "
+                    "mode (the displacement buffer is lane state; a "
+                    "per-round client rebinding would hand lane i's "
+                    "displacement to a different client) — drop one of "
+                    "the two")
+            if self.mesh.size > 1:
+                raise ValueError(
+                    "fused_update='on' needs a single-device worker "
+                    f"mesh (got {self.mesh.shape}): the Pallas epilogue "
+                    "contracts the full worker axis in one kernel call; "
+                    "multi-device meshes keep the dense or scatter "
+                    "paths")
+        fused_on = self._fused_on
+        fused_spec = None
+        fused_mix_update = None
+        self._fused_spec = None
+        # The displacement buffer: round −1's local step is defined as
+        # zero, so fused round 0 contracts exactly what the default
+        # round 0 mixes.  Built from fresh zeros — round_fn donates it,
+        # and a donated input must never alias the init tree.
+        self._fused_buf: object = {}
+        if self._fused_on:
+            from dopt.ops.fused_update import fused_mix_update
+
+            self._fused_spec = make_update_shard_spec(
+                stacked, fold=self.mesh.size,
+                bucket_bytes=int(g.update_bucket_mb * (1 << 20)))
+            self._fused_buf = shard_worker_tree(
+                jax.tree.map(np.zeros_like, stacked), self.mesh)
+            fused_spec = self._fused_spec
+
         def mix_once(x, arg):
             """One consensus sweep; ``arg`` is the [n, n] matrix (dense)
             or the [k, n] coefficient table (shift) for the round."""
@@ -1068,15 +1160,25 @@ class GossipTrainer:
         def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
                      bweight, train_x, train_y, ex, ey, ew, vidx, vw,
                      do_eval, cmask=None, quar=None, prev=None,
-                     wdiag=None):
+                     wdiag=None, fbuf=None):
             # Async: this round's ENTRY state is what the neighbors
             # read NEXT round — it becomes the new prev buffer.
             entry = params if prev is not None else None
             w_matrix, alive, cmask = effective_inputs(w_matrix, alive,
                                                       quar, cmask)
-            params, x_hat, screened = consensus_phase(
-                params, x_hat, w_matrix, alive, t, cmask, prev=prev,
-                wdiag=wdiag)
+            if fused_on:
+                # ONE HBM pass over the flat buckets:
+                # q_t = W_t·q_{t-1} − fbuf_{t-1} (mix + pending local
+                # displacement fused; ``params`` carries the POST-MIX
+                # state q, the buffer its distance to the post-local
+                # endpoint).
+                params = fused_mix_update(params, fbuf, w_matrix,
+                                          fused_spec, lr=1.0)
+                screened = jnp.zeros(w, jnp.float32)
+            else:
+                params, x_hat, screened = consensus_phase(
+                    params, x_hat, w_matrix, alive, t, cmask, prev=prev,
+                    wdiag=wdiag)
             evalm = jax.lax.cond(
                 do_eval,
                 lambda: evaluator(params, ex, ey, ew),
@@ -1096,11 +1198,24 @@ class GossipTrainer:
             diag = (round_diag(p_t, m_t, params, losses, alive)
                     if diag_on else None)
             packed = pack_host_metrics(tl, ta, evalm, em, screened, diag)
+            if fused_on:
+                # Next round's contraction folds this displacement in.
+                # Dead lanes carried q (p_t == params) → a zero row:
+                # the lane freezes through the next repaired mix.
+                new_fbuf = jax.tree.map(lambda a, b: a - b, params, p_t)
+                return params, m_t, x_hat, new_fbuf, packed
             if prev is not None:
                 return p_t, m_t, x_hat, entry, packed
             return p_t, m_t, x_hat, packed
 
-        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
+        # Donating the displacement buffer (fused runs only — the
+        # kwarg-name donation keeps the default path's jit params, and
+        # therefore its fingerprinted programs, byte-identical) lets
+        # XLA alias new_fbuf into fbuf's pages: the round carry costs
+        # zero extra HBM over the unfused path.
+        _fused_donate = {"donate_argnames": ("fbuf",)} if fused_on else {}
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2),
+                                 **_fused_donate)
         self._sharding = worker_sharding(self.mesh)
 
         # Fused multi-round block path (lax.scan over rounds in ONE jit).
@@ -1121,7 +1236,7 @@ class GossipTrainer:
         def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
                      is_eval, train_x, train_y, ex, ey, ew, vidx, vw,
                      cmasks=None, streak=None, until=None, prev=None,
-                     wdiags=None):
+                     wdiags=None, fbuf=None):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -1137,7 +1252,7 @@ class GossipTrainer:
             without surfacing flags to the host mid-block."""
 
             def body(carry, xs):
-                pv = wd_t = None
+                pv = wd_t = fb = None
                 if fused_quar:
                     p, m, xh, stk, unt = carry
                 elif is_async:
@@ -1145,6 +1260,11 @@ class GossipTrainer:
                     # previous round's entry state; this round's entry
                     # replaces it after the mix.
                     p, m, xh, pv = carry
+                    stk = unt = None
+                elif fused_on:
+                    # Fused carry: p is the POST-MIX state q, fb the
+                    # displacement to the post-local endpoint.
+                    p, m, xh, fb = carry
                     stk = unt = None
                 else:
                     p, m, xh = carry
@@ -1168,8 +1288,12 @@ class GossipTrainer:
                     quar_t = (unt > t_t).astype(jnp.float32)
                     w_t, alive_t, cm_t = effective_inputs(w_t, alive_t,
                                                           quar_t, cm_t)
-                p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t,
-                                             cm_t, prev=pv, wdiag=wd_t)
+                if fused_on:
+                    p = fused_mix_update(p, fb, w_t, fused_spec, lr=1.0)
+                    scr = jnp.zeros(w, jnp.float32)
+                else:
+                    p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t,
+                                                 cm_t, prev=pv, wdiag=wd_t)
                 evalm = jax.lax.cond(ev_t, lambda: ev(p, ex, ey, ew), zeros_eval)
                 if use_holdout:
                     p_t, m_t, losses, accs, em = local_phase(
@@ -1195,6 +1319,9 @@ class GossipTrainer:
                     return (p_t, m_t, xh, stk, unt), packed
                 if is_async:
                     return (p_t, m_t, xh, entry), packed
+                if fused_on:
+                    new_fb = jax.tree.map(lambda a, b: a - b, p, p_t)
+                    return (p, m_t, xh, new_fb), packed
                 return (p_t, m_t, xh), packed
 
             xs = [w_mats, alive, limits, ts, idx, bw, is_eval]
@@ -1206,6 +1333,8 @@ class GossipTrainer:
                 carry0 = (params, mom, x_hat, streak, until)
             elif is_async:
                 carry0 = (params, mom, x_hat, prev)
+            elif fused_on:
+                carry0 = (params, mom, x_hat, fbuf)
             else:
                 carry0 = (params, mom, x_hat)
             carry, packed = jax.lax.scan(body, carry0, tuple(xs))
@@ -1214,10 +1343,14 @@ class GossipTrainer:
             if is_async:
                 params, mom, x_hat, prev = carry
                 return params, mom, x_hat, prev, packed
+            if fused_on:
+                params, mom, x_hat, fbuf = carry
+                return params, mom, x_hat, fbuf, packed
             params, mom, x_hat = carry
             return params, mom, x_hat, packed
 
-        self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2))
+        self._block_fn = jax.jit(block_fn, donate_argnums=(0, 1, 2),
+                                 **_fused_donate)
 
         # ---- lossy-link / push-sum consensus path ---------------------
         # Engine state: `_mass` is the push-sum mass vector (ones —
@@ -1527,6 +1660,8 @@ class GossipTrainer:
                 if self._async:
                     step_kw.update(prev=self._async_prev,
                                    wdiags=jnp.asarray(payload["wdiags"]))
+                if self._fused_on:
+                    step_kw["fbuf"] = self._fused_buf
                 fn = self._block_fn
                 args = (self.params, self.momentum, self.x_hat, *common)
             if stager is None:
@@ -1558,6 +1693,9 @@ class GossipTrainer:
             elif self._async:
                 (self.params, self.momentum, self.x_hat,
                  self._async_prev, packed) = out
+            elif self._fused_on:
+                (self.params, self.momentum, self.x_hat,
+                 self._fused_buf, packed) = out
             else:
                 (self.params, self.momentum, self.x_hat, packed) = out
             packed = np.asarray(packed)  # ONE device→host fetch per block
@@ -1997,6 +2135,9 @@ class GossipTrainer:
             elif self._async:
                 (self.params, self.momentum, self.x_hat,
                  self._async_prev, packed) = out
+            elif self._fused_on:
+                (self.params, self.momentum, self.x_hat,
+                 self._fused_buf, packed) = out
             else:
                 self.params, self.momentum, self.x_hat, packed = out
             tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
@@ -2091,6 +2232,8 @@ class GossipTrainer:
             w_t, wdiag = w_t
             step_kw["prev"] = self._async_prev
             step_kw["wdiag"] = jnp.asarray(wdiag)
+        if self._fused_on:
+            step_kw["fbuf"] = self._fused_buf
         args = (self.params, self.momentum, self.x_hat, w_t, alive,
                 limits, jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
@@ -2143,6 +2286,12 @@ class GossipTrainer:
             # it a resumed async run would mix round t against the
             # wrong previous-round snapshot.
             arrays["async_prev"] = self._async_prev
+        if self._fused_on:
+            # The displacement buffer is carried engine state — and the
+            # carried "params" are the POST-MIX q, not the post-local
+            # endpoint — so a fused resume needs both trees to contract
+            # round t exactly as the unkilled run would.
+            arrays["fused_buf"] = self._fused_buf
         if self._link_mode:
             # Push-sum mass and the staleness buffers are carried engine
             # state: without them a resumed lossy-link run would replay
@@ -2191,6 +2340,23 @@ class GossipTrainer:
                     "state ('async_prev') in the checkpoint")
             self._async_prev = shard_worker_tree(arrays["async_prev"],
                                                  self.mesh)
+        if self._fused_on:
+            if "fused_buf" not in arrays:
+                raise ValueError(
+                    "fused_update='on' trainer requires its displacement "
+                    "buffer ('fused_buf') in the checkpoint — this "
+                    "checkpoint is from a fused_update='off' run, whose "
+                    "carried params are the post-local endpoint, not "
+                    "the (post-mix, displacement) pair")
+            self._fused_buf = shard_worker_tree(arrays["fused_buf"],
+                                                self.mesh)
+        elif "fused_buf" in arrays:
+            raise ValueError(
+                "checkpoint carries a fused displacement buffer "
+                "('fused_buf') but this trainer runs fused_update='off' "
+                "— the checkpoint's 'params' are the post-mix state q, "
+                "not the post-local endpoint; restore with "
+                "fused_update='on'")
         if self._link_mode:
             if self._push_sum:
                 if "push_mass" not in arrays:
@@ -2262,6 +2428,12 @@ class GossipTrainer:
         params/mass (the quantity that converges to the true average
         under lossy links).  The divide runs on device so callers never
         pay a host round-trip for it."""
+        if self._fused_on:
+            # Fused carry holds the POST-MIX state q and the pending
+            # displacement; the round's semantic endpoint — what the
+            # default path carries as params — is q − fbuf.
+            return jax.tree.map(lambda a, b: a - b, self.params,
+                                self._fused_buf)
         if not self._push_sum:
             return self.params
         mass = self._mass

@@ -2,9 +2,10 @@
 
 ``results/bench_history.jsonl`` is an append-only ledger: every
 bench.py headline JSON line lands as one entry stamped with the git sha
-and a run id (``append_entry`` — deduped on ``run_id``, so a re-run
-replaces its prior entry instead of stacking duplicates that skew the
-trailing trimmed median), so the r01→r05 trajectory the committed
+and a run id (``append_entry`` — deduped on ``(run_id, metric)``, so a
+re-run replaces its prior entry instead of stacking duplicates that
+skew the trailing trimmed median, while one run's several metric lines
+— headline + seqlm — coexist), so the r01→r05 trajectory the committed
 ``BENCH_r*.json`` files hold becomes data a regressor can watch — per
 run, not per postmortem.
 
@@ -61,6 +62,9 @@ TRACKED_METRICS: dict[str, str] = {
     "clients_per_sec_1k": "higher",
     "clients_per_sec_10k": "higher",
     "host_gap_pct": "lower",
+    "fused_rounds_per_sec": "higher",
+    "fused_speedup": "higher",
+    "seqlm_tokens_per_sec": "higher",
 }
 
 
@@ -97,12 +101,14 @@ def append_entry(path: str | Path, headline: dict[str, Any], *,
     """Append one headline to the ledger (sha auto-detected when not
     given); returns the entry written.
 
-    DEDUPED on ``run_id``: a re-run at the same run id REPLACES its
-    prior entry (the ledger is atomically rewritten without the
-    duplicates) instead of stacking copies — N retries of one run would
-    otherwise occupy N slots of the trailing window and drag the
-    trimmed median toward that single run's value.  Fresh run ids take
-    the plain-append fast path.
+    DEDUPED on ``(run_id, metric)``: a re-run at the same run id
+    REPLACES its prior entry for that metric (the ledger is atomically
+    rewritten without the duplicates) instead of stacking copies — N
+    retries of one run would otherwise occupy N slots of the trailing
+    window and drag the trimmed median toward that single run's value.
+    One run's SEVERAL metric lines (the gossip headline plus the seqlm
+    leg) land as separate entries under the shared run id.  Fresh
+    slots take the plain-append fast path.
 
     The pre-append scan parses TOLERANTLY (unlike ``read_ledger``'s
     strict contract): the plain-append path is not atomic, so a crash
@@ -129,12 +135,19 @@ def append_entry(path: str | Path, headline: dict[str, Any], *,
                 existing.append(e)
             else:
                 torn = True
-        if torn or any(e.get("run_id") == entry["run_id"]
-                       for e in existing):
+
+        def _same_slot(e):
+            # Dedup key is (run_id, metric): one run legitimately
+            # appends several metric lines (headline + seqlm), and
+            # only a re-run of the SAME metric replaces its entry.
+            return (e.get("run_id") == entry["run_id"]
+                    and (e.get("bench") or {}).get("metric")
+                    == entry["bench"]["metric"])
+
+        if torn or any(_same_slot(e) for e in existing):
             from dopt.utils.metrics import atomic_write_text
 
-            kept = [e for e in existing
-                    if e.get("run_id") != entry["run_id"]]
+            kept = [e for e in existing if not _same_slot(e)]
             kept.append(entry)
             atomic_write_text(path, "".join(
                 json.dumps(e, separators=(",", ":")) + "\n"
@@ -207,6 +220,18 @@ def check_regression(entries: list[dict[str, Any]],
                 if isinstance(e["bench"].get(name), (int, float))
                 and not isinstance(e["bench"].get(name), bool)]
         if len(hist) < min_history:
+            # The candidate CARRIES this metric but the trailing window
+            # does not (a newly-promoted headline field, e.g. the fused
+            # or seqlm legs) — report NO_BASELINE explicitly instead of
+            # silently passing, so a first-seen metric starts an honest
+            # window the reader can see filling up.
+            result["checks"].append({
+                "metric": name, "candidate": float(cv),
+                "baseline_median": None, "delta_pct": None,
+                "band_pct": None, "n_baseline": len(hist),
+                "direction": direction, "regressed": False,
+                "no_baseline": True,
+            })
             continue
         med, spread, _ = trimmed_stats(hist)
         if med == 0:
@@ -236,6 +261,12 @@ def format_report(result: dict[str, Any]) -> str:
                      "entries with this (metric, device_kind) key — "
                      "nothing to judge against yet")
     for c in result.get("checks", []):
+        if c.get("no_baseline"):
+            lines.append(
+                f"  {c['metric']:<28} {c['candidate']:>12.4g} "
+                f"NO_BASELINE (n={c['n_baseline']} prior entries carry "
+                "this metric — window still filling)")
+            continue
         arrow = "REGRESSED" if c["regressed"] else "ok"
         lines.append(
             f"  {c['metric']:<28} {c['candidate']:>12.4g} vs median "

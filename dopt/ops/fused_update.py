@@ -179,11 +179,14 @@ def fused_mix_update(params, momentum, w_matrix, spec, *, lr: float,
     (``dopt.parallel.collectives.stacked_to_buckets``), run the fused
     ``W @ p − lr·buf`` kernel per bucket, and restore the tree.  The
     single-pass form of the D-PSGD round epilogue ``x ← Wx − lr·v`` on
-    the same flat-bucket substrate the scatter hot path uses.  Engine
-    wiring is the follow-on: the faithful round order (consensus →
-    eval → local update) means fusing the mix with the previous
-    round's displacement needs the scan carry restructured, which must
-    land without perturbing the oracle-parity trace.
+    the same flat-bucket substrate the scatter hot path uses.  Both
+    engines wire it behind ``fused_update="on"`` with a restructured
+    scan carry: gossip carries (post-mix params, displacement buffer)
+    and calls this with ``lr=1.0`` (``q_t = W·q − fbuf``); federated
+    carries the theta broadcast slab and calls it with the masked-mean
+    contraction matrix and ``lr=-1.0`` (``θ'_b = M·disp + θ_b``).  The
+    default ``"off"`` compiles the exact pre-change programs, so the
+    oracle-parity trace is untouched.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpret mode
     elsewhere (same code path, testable on CPU).

@@ -284,6 +284,20 @@ def masked_average(stacked, mask, mesh: Mesh | None = None, comm_dtype=None):
         return jax.tree.map(avg_leaf, stacked)
 
 
+def mean_weight_matrix(mask):
+    """The masked-mean reduce as a [W, W] contraction matrix: every row
+    is mask / max(Σ mask, 1), so W_mean @ X computes ``masked_average``
+    broadcast back over the worker axis (each output row is the same
+    global mean).  An all-dead mask yields the zero matrix — the
+    contraction contributes nothing and the caller's passthrough term
+    keeps theta.  Feeds the fused epilogue (``dopt.ops.fused_mix_update``
+    under ``FederatedConfig.fused_update="on"``), which needs the mean
+    expressed as a mixing-matrix contraction over the flat buckets."""
+    m = jnp.asarray(mask, dtype=jnp.float32).reshape(-1)
+    denom = jnp.maximum(m.sum(), 1.0)
+    return jnp.broadcast_to(m / denom, (m.shape[0], m.shape[0]))
+
+
 def _masked_average_compressed(stacked, m, denom, mesh: Mesh, comm_dtype):
     """Wire-only compressed federated reduce: each device sums its local
     lanes at full precision, the narrow PARTIAL sums are all-gathered

@@ -379,6 +379,30 @@ def test_bench_ledger_dedupes_on_run_id(tmp_path):
     assert len(path.read_text().splitlines()) == 2
 
 
+def test_bench_ledger_keeps_multiple_metrics_per_run(tmp_path):
+    """One real bench run appends SEVERAL metric lines (the gossip
+    headline plus the seqlm leg) under the shared run id — the dedup
+    key is (run_id, metric), so the second append must not swallow the
+    first, while a re-run of the same metric still replaces it."""
+    from dopt.obs.regress import append_entry, read_ledger
+
+    path = tmp_path / "bench_history.jsonl"
+    append_entry(path, {"metric": "gossip", "value": 2.0},
+                 run_id="r7", sha="s")
+    append_entry(path, {"metric": "seqlm", "value": 900.0},
+                 run_id="r7", sha="s")
+    entries = read_ledger(path)
+    assert [(e["run_id"], e["bench"]["metric"]) for e in entries] == [
+        ("r7", "gossip"), ("r7", "seqlm")]
+    # Same (run_id, metric) slot replaces; the sibling metric survives.
+    append_entry(path, {"metric": "seqlm", "value": 950.0},
+                 run_id="r7", sha="s")
+    entries = read_ledger(path)
+    assert len(entries) == 2
+    assert entries[-1]["bench"]["value"] == 950.0
+    assert entries[0]["bench"]["metric"] == "gossip"
+
+
 def test_bench_ledger_append_survives_torn_line(tmp_path):
     """The plain-append path is not atomic, so a crash can tear the
     final line; the next append must not raise, must not glue its entry

@@ -1,0 +1,293 @@
+"""Engine-level fused mix+update epilogue (``fused_update='on'``).
+
+Gossip carries (post-mix params, displacement) and contracts the round
+epilogue as ONE ``fused_mix_update`` pass — the D-PSGD update ordering
+(arXiv:1705.09056), a documented variant of (allclose to, not bit-equal
+with) the default mix-then-local trace.  Federated carries the theta
+broadcast slab and fuses the masked average with the theta step — equal
+to the default trace up to f32 reassociation.  Both must be
+bit-reproducible across per-round / blocked / prefetched execution and
+across kill-and-resume mid-block, and every mode the fused epilogue
+cannot yet speak must be rejected loudly at construction.
+
+Kernel-level parity (the Pallas pass vs the jnp composition) lives in
+``tests/test_ops.py``; this file owns the engine wiring.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dopt.config import (DataConfig, ExperimentConfig, FederatedConfig,
+                         GossipConfig, ModelConfig, OptimizerConfig,
+                         RobustConfig)
+from dopt.engine import FederatedTrainer, GossipTrainer
+
+
+def _flat(tree):
+    return np.concatenate([np.ravel(np.asarray(x))
+                           for x in jax.tree.leaves(jax.device_get(tree))])
+
+
+def _gossip_cfg(fused="on", lr=0.05, rounds=6, robust=None, population=None,
+                **gossip_kw):
+    g = dict(algorithm="dsgd", topology="circle", mode="metropolis",
+             rounds=rounds, local_ep=1, local_bs=32, fused_update=fused)
+    g.update(gossip_kw)
+    return ExperimentConfig(
+        name="fused-g", seed=11,
+        data=DataConfig(dataset="synthetic", num_users=4,
+                        synthetic_train_size=256, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=lr, momentum=0.9),
+        gossip=GossipConfig(**g),
+        robust=robust, population=population,
+        # The fused epilogue contracts the full worker axis in one
+        # kernel call — single-device mesh by construction.
+        mesh_devices=1,
+    )
+
+
+def _fed_cfg(fused="on", algorithm="fedavg", rounds=4, robust=None,
+             **fed_kw):
+    f = dict(algorithm=algorithm, frac=0.5, rounds=rounds, local_ep=1,
+             local_bs=32, fused_update=fused)
+    f.update(fed_kw)
+    return ExperimentConfig(
+        name="fused-f", seed=13,
+        data=DataConfig(dataset="synthetic", num_users=4,
+                        synthetic_train_size=256, synthetic_test_size=64),
+        model=ModelConfig(model="mlp", input_shape=(28, 28, 1),
+                          faithful=False),
+        optim=OptimizerConfig(lr=0.05, momentum=0.9),
+        federated=FederatedConfig(**f),
+        robust=robust,
+        mesh_devices=1,
+    )
+
+
+# ---------------------------------------------------------------------
+# Gossip: parity with the reference trace
+# ---------------------------------------------------------------------
+
+def test_gossip_fused_first_round_matches_off_exactly(devices):
+    # Round 0 contracts a zero displacement, so mix-then-local is the
+    # SAME computation in both orderings: the fused trainer's debiased
+    # params (q_0 − fbuf_0 = the post-local iterate) must match the off
+    # path to kernel-reassociation tolerance.
+    a = GossipTrainer(_gossip_cfg(fused="off", rounds=1))
+    a.run(rounds=1)
+    b = GossipTrainer(_gossip_cfg(fused="on", rounds=1))
+    b.run(rounds=1)
+    np.testing.assert_allclose(_flat(b._debiased_params()), _flat(a.params),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gossip_fused_lr0_is_pure_consensus_parity(devices):
+    # With lr=0 the local step is the identity, every displacement is
+    # zero, and BOTH orderings degenerate to repeated mixing — the
+    # fused multi-round trajectory must agree with the off path to
+    # kernel tolerance (a true end-to-end parity check of the Pallas
+    # contraction inside the engine).
+    a = GossipTrainer(_gossip_cfg(fused="off", lr=0.0, rounds=4))
+    a.run(rounds=4)
+    b = GossipTrainer(_gossip_cfg(fused="on", lr=0.0, rounds=4))
+    b.run(rounds=4)
+    np.testing.assert_allclose(_flat(b.params), _flat(a.params),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gossip_fused_is_bounded_variant_of_default_ordering(devices):
+    # lr > 0: the D-PSGD ordering folds the local step in unmixed, so
+    # the trajectory is a VARIANT of the default — close (the
+    # displacement re-enters through the next round's contraction) but
+    # not bit-equal.  Both halves of that contract are asserted.
+    a = GossipTrainer(_gossip_cfg(fused="off", rounds=4))
+    a.run(rounds=4)
+    b = GossipTrainer(_gossip_cfg(fused="on", rounds=4))
+    b.run(rounds=4)
+    fa, fb = _flat(a.params), _flat(b._debiased_params())
+    assert np.max(np.abs(fa - fb)) > 0.0  # genuinely a different ordering
+    np.testing.assert_allclose(fb, fa, rtol=0.0, atol=0.1)
+
+
+# ---------------------------------------------------------------------
+# Gossip: execution-path bit-identity + resume
+# ---------------------------------------------------------------------
+
+def test_gossip_fused_blocked_and_prefetched_bit_identical(devices):
+    a = GossipTrainer(_gossip_cfg())
+    a.run(rounds=6)
+    b = GossipTrainer(_gossip_cfg())
+    b.run(rounds=6, block=3)
+    c = GossipTrainer(_gossip_cfg(prefetch="on"))
+    c.run(rounds=6, block=3)
+    fa = _flat(a.params)
+    np.testing.assert_array_equal(fa, _flat(b.params))
+    np.testing.assert_array_equal(fa, _flat(c.params))
+    assert a.history.rows == b.history.rows == c.history.rows
+
+
+def test_gossip_fused_resume_mid_block_bit_identical(devices, tmp_path):
+    cont = GossipTrainer(_gossip_cfg())
+    cont.run(rounds=6, block=2)
+    a = GossipTrainer(_gossip_cfg())
+    a.run(rounds=3, block=2)  # ends on a remainder (mid-block) round
+    a.save(tmp_path / "ck")
+    b = GossipTrainer(_gossip_cfg())
+    b.restore(tmp_path / "ck")
+    b.run(rounds=3, block=2)
+    np.testing.assert_array_equal(_flat(cont.params), _flat(b.params))
+    np.testing.assert_array_equal(_flat(cont._fused_buf),
+                                  _flat(b._fused_buf))
+    assert cont.history.rows == b.history.rows
+
+
+def test_gossip_fused_checkpoint_direction_guards(devices, tmp_path):
+    # The displacement buffer is load-bearing state: a fused trainer
+    # cannot silently adopt an unfused checkpoint, nor the reverse.
+    on = GossipTrainer(_gossip_cfg())
+    on.run(rounds=2)
+    on.save(tmp_path / "on")
+    off = GossipTrainer(_gossip_cfg(fused="off"))
+    off.run(rounds=2)
+    off.save(tmp_path / "off")
+    with pytest.raises(ValueError, match="fused"):
+        GossipTrainer(_gossip_cfg()).restore(tmp_path / "off")
+    with pytest.raises(ValueError, match="fused"):
+        GossipTrainer(_gossip_cfg(fused="off")).restore(tmp_path / "on")
+
+
+# ---------------------------------------------------------------------
+# Gossip: eligibility — loud construction rejections
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw,pattern", [
+    (dict(algorithm="nocons"), "no such sweep"),
+    (dict(mixing="async"), "does not compose"),
+    (dict(update_sharding="scatter"), "drop one of the two"),
+    (dict(comm_dtype="bfloat16"), "comm_dtype"),
+    (dict(comm_impl="shift"), "incompatible"),
+], ids=["algorithm", "async", "scatter", "comm_dtype", "shift"])
+def test_gossip_fused_rejections(devices, kw, pattern):
+    with pytest.raises(ValueError, match=pattern):
+        GossipTrainer(_gossip_cfg(**kw))
+
+
+def test_gossip_fused_rejects_robust_layer(devices):
+    with pytest.raises(ValueError, match="robust"):
+        GossipTrainer(_gossip_cfg(robust=RobustConfig(clip_radius=1.0)))
+
+
+def test_gossip_fused_off_accepts_everything(devices):
+    # The default must not reject anything: "off" is byte-identical to
+    # the pre-change construction.
+    GossipTrainer(_gossip_cfg(fused="off", update_sharding="scatter"))
+    GossipTrainer(_gossip_cfg(fused="off",
+                              robust=RobustConfig(clip_radius=1.0)))
+
+
+# ---------------------------------------------------------------------
+# Federated: parity with the reference trace
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox"])
+def test_federated_fused_matches_off_allclose(devices, algorithm):
+    # The fused masked-mean contraction equals the default
+    # masked_average + assign up to f32 reassociation — theta AND the
+    # worker lanes must track the off path through partial
+    # participation (frac=0.5).
+    a = FederatedTrainer(_fed_cfg(fused="off", algorithm=algorithm))
+    a.run(rounds=3)
+    b = FederatedTrainer(_fed_cfg(fused="on", algorithm=algorithm))
+    b.run(rounds=3)
+    np.testing.assert_allclose(_flat(b._theta_single()), _flat(a.theta),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_flat(b.params), _flat(a.params),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_federated_fused_slab_rows_bit_identical(devices):
+    # Every row of the carried theta slab is the same global model —
+    # the invariant that makes row-0 checkpointing exact.
+    tr = FederatedTrainer(_fed_cfg())
+    tr.run(rounds=3)
+    for leaf in jax.tree.leaves(jax.device_get(tr.theta)):
+        row0 = np.asarray(leaf)[0]
+        for r in range(1, np.asarray(leaf).shape[0]):
+            np.testing.assert_array_equal(np.asarray(leaf)[r], row0)
+
+
+def test_federated_fused_blocked_and_prefetched_bit_identical(devices):
+    a = FederatedTrainer(_fed_cfg())
+    a.run(rounds=6)
+    b = FederatedTrainer(_fed_cfg())
+    b.run(rounds=6, block=3)
+    c = FederatedTrainer(_fed_cfg(prefetch="on"))
+    c.run(rounds=6, block=3)
+    fa = _flat(a.theta)
+    np.testing.assert_array_equal(fa, _flat(b.theta))
+    np.testing.assert_array_equal(fa, _flat(c.theta))
+    assert a.history.rows == b.history.rows == c.history.rows
+
+
+def test_federated_fused_resume_mid_block_bit_identical(devices, tmp_path):
+    cont = FederatedTrainer(_fed_cfg())
+    cont.run(rounds=6, block=2)
+    a = FederatedTrainer(_fed_cfg())
+    a.run(rounds=3, block=2)
+    a.save(tmp_path / "ck")
+    b = FederatedTrainer(_fed_cfg())
+    b.restore(tmp_path / "ck")
+    b.run(rounds=3, block=2)
+    np.testing.assert_array_equal(_flat(cont.theta), _flat(b.theta))
+    assert cont.history.rows == b.history.rows
+
+
+def test_federated_fused_checkpoints_interchangeable(devices, tmp_path):
+    # The federated checkpoint stores the single-tree theta (slab
+    # row 0), so fused and unfused trainers can adopt each other's
+    # checkpoints — resume trajectories agree to reassociation.
+    on = FederatedTrainer(_fed_cfg())
+    on.run(rounds=2)
+    on.save(tmp_path / "on")
+    off = FederatedTrainer(_fed_cfg(fused="off"))
+    off.restore(tmp_path / "on")
+    off.run(rounds=2)
+    on.run(rounds=2)
+    np.testing.assert_allclose(_flat(on._theta_single()), _flat(off.theta),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------
+# Federated: eligibility — loud construction rejections
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw,pattern", [
+    (dict(algorithm="scaffold"), "companion state"),
+    (dict(staleness_max=2), "staleness"),
+    (dict(compact=True), "compact"),
+    (dict(comm_dtype="bfloat16"), "comm_dtype"),
+], ids=["algorithm", "staleness", "compact", "comm_dtype"])
+def test_federated_fused_rejections(devices, kw, pattern):
+    with pytest.raises(ValueError, match=pattern):
+        FederatedTrainer(_fed_cfg(**kw))
+
+
+@pytest.mark.parametrize("robust,pattern", [
+    (RobustConfig(aggregator="trimmed_mean", trim_frac=0.25),
+     "masked-mean"),
+    (RobustConfig(clip_radius=1.0), "clip_radius"),
+], ids=["aggregator", "clip_radius"])
+def test_federated_fused_rejects_robust(devices, robust, pattern):
+    with pytest.raises(ValueError, match=pattern):
+        FederatedTrainer(_fed_cfg(robust=robust))
+
+
+def test_federated_fused_allows_quarantine_only_robust(devices):
+    # Quarantine acts through the participation mask, which the fused
+    # contraction already reads — mask-side robustness stays eligible.
+    tr = FederatedTrainer(_fed_cfg(
+        robust=RobustConfig(quarantine_after=2, quarantine_rounds=2)))
+    tr.run(rounds=2)
