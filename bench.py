@@ -91,6 +91,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -226,6 +227,10 @@ def _measure_chaos(train_size: int, test_size: int, rounds: int,
         "chaos_speedup_vs_per_round": round(
             blocked["rounds_per_sec"] / per_round["rounds_per_sec"], 2),
         "chaos_samples_per_sec": round(blocked["samples_per_sec"], 1),
+        # Un-prefixed on purpose: the quick artifact spreads this dict
+        # into its top level, and the CI gate asserts bytes_on_wire is
+        # present-and-finite there.
+        "bytes_on_wire": blocked["bytes_on_wire"],
     }
 
 
@@ -310,6 +315,8 @@ def _measure_topology_modes(*, train_size: int, test_size: int,
         "spread_pct": round(one_peer["spread_pct"], 2),
         "samples_per_sec": round(one_peer["samples_per_sec"], 1),
         "host_gap_pct": round(one_peer["host_gap_pct"], 2),
+        "bytes_on_wire": one_peer["bytes_on_wire"],
+        "dense_bytes_on_wire": dense["bytes_on_wire"],
     }
 
 
@@ -422,6 +429,28 @@ def _trimmed_stats(values):
     from dopt.utils.metrics import trimmed_stats
 
     return trimmed_stats(values)
+
+
+def _bytes_on_wire(cfg) -> float:
+    """Per-round collective bytes of ``cfg``'s compiled round program
+    (``hlo_collective_bytes`` over ``lower_round``'s compiled HLO) — the
+    bytes-on-wire headline every bench leg now carries.  Probed on a
+    THROWAWAY trainer: ``lower_round`` consumes the run loop's stateful
+    host draws, so probing the measured trainer would shift its fault /
+    sampling streams.  On a 1-device mesh collectives compile away and
+    the honest answer is 0.0; any probe failure degrades to 0.0 with a
+    note rather than taking down the wall-clock benchmark."""
+    try:
+        from dopt.engine import GossipTrainer
+        from dopt.parallel.collectives import hlo_collective_bytes
+
+        probe = GossipTrainer(cfg, eval_every=1 << 20)
+        _, lowered = probe.lower_round()
+        return float(hlo_collective_bytes(lowered.compile().as_text())
+                     ["total"])
+    except Exception as e:  # pragma: no cover - environment-dependent
+        print(f"# bytes-on-wire probe unavailable: {e!r}", file=sys.stderr)
+        return 0.0
 
 
 def _measure(cfg, rounds: int, block: int, repeats: int = 5,
@@ -587,6 +616,13 @@ def _measure(cfg, rounds: int, block: int, repeats: int = 5,
                        value=float(out["host_gap_pct"]))
         telemetry.emit("gauge", round=r, name="host_batch_plan_fraction",
                        value=float(plan_frac))
+    # Bytes-on-wire is a first-class column of every measured leg: the
+    # compiled round program's collective bytes (0.0 on a 1-device
+    # mesh, where there IS no wire).
+    out["bytes_on_wire"] = _bytes_on_wire(cfg)
+    if telemetry is not None:
+        telemetry.emit("gauge", round=max(trainer.round - 1, 0),
+                       name="bytes_on_wire", value=out["bytes_on_wire"])
     # Post-run accuracy reflects ALL rounds trained above (ADVICE r4):
     # the count is recorded so the accuracy column is interpretable.
     out["total_trained_rounds"] = trained
@@ -705,6 +741,7 @@ def _measure_fused_modes(*, train_size: int, test_size: int, rounds: int,
         "spread_pct": round(fused["spread_pct"], 2),
         "samples_per_sec": round(fused["samples_per_sec"], 1),
         "host_gap_pct": round(fused["host_gap_pct"], 2),
+        "bytes_on_wire": fused["bytes_on_wire"],
     }
     if hbm_rounds:
         hbm = _hbm_reuse_measure(rounds=hbm_rounds)
@@ -713,6 +750,153 @@ def _measure_fused_modes(*, train_size: int, test_size: int, rounds: int,
                     "growth_pct", "hbm_source"):
             if key in hbm:
                 result["hbm_reuse_" + key.removeprefix("hbm_")] = hbm[key]
+    return result
+
+
+def _measure_comm_modes(*, train_size: int, test_size: int, rounds: int,
+                        repeats: int, workers: int = 8,
+                        conv_rounds: int = 24, probe_devices: int = 4,
+                        telemetry=None, max_spread: float = 0.0) -> dict:
+    """Standalone r08 mode: the comm-substrate codec headline under its
+    own ledger key (the r06/r07 standalone-workload pattern).
+
+    Three measured bases, one entry:
+
+    * **bytes on wire** — the compiled-HLO collective bytes of the
+      dense / raw-scatter / codec round programs, probed in a
+      subprocess (``python -m dopt.analysis.comm_bytes``) so the
+      multi-device host mesh can be forced before jax init when the
+      bench itself runs on a 1-device CPU backend.  The headline
+      ``wire_compression`` is dense/codec — gather-vs-gather, the fair
+      op-kind pairing (module docstring there).
+    * **throughput** — ``_measure`` on the raw-scatter and codec legs
+      (identical workload, fault-free); ``value`` is the codec leg's
+      rounds/sec (``compressed_rounds_per_sec`` in the regress ledger:
+      the codec must not buy its bytes with a dispatch-bound round).
+    * **rounds to target** — both legs re-run with the lossy preset's
+      crash + churn cocktail armed (its ``msg_*`` knobs price the byte
+      budget instead — they run the per-staleness link engine, a
+      different wire) for ``conv_rounds`` blocked rounds; the target is
+      the raw leg's final train loss × 1.02 and each leg reports the
+      first round that reaches it, so the ledger shows the compression
+      schedule still trains, not just that it shrinks the wire."""
+    import subprocess
+
+    from dopt.analysis.comm_bytes import (comm_modes_config,
+                                          lossy_budget_bytes)
+
+    kind, _ = _device_peak_flops()
+    probe = None
+    cmd = [sys.executable, "-m", "dopt.analysis.comm_bytes",
+           "--workers", str(workers), "--devices", str(probe_devices),
+           "--train-size", str(train_size), "--test-size", str(test_size)]
+    try:
+        run = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=1_200, cwd=os.path.dirname(
+                                 os.path.abspath(__file__)))
+        if run.returncode == 0:
+            probe = json.loads(run.stdout.strip().splitlines()[-1])
+        else:
+            print(f"# comm-bytes probe rc={run.returncode}: "
+                  f"{run.stderr.strip().splitlines()[-1:]}",
+                  file=sys.stderr)
+    except Exception as e:  # pragma: no cover - environment-dependent
+        print(f"# comm-bytes probe unavailable: {e!r}", file=sys.stderr)
+    if probe is not None:
+        budget = int(probe["budget_bytes"])
+    else:
+        # Fallback budget derivation (the CLI's own path), in-process:
+        # spec widths are device-count independent.
+        from dopt.engine import GossipTrainer
+
+        tr = GossipTrainer(
+            comm_modes_config("scatter", workers=workers,
+                              train_size=train_size, test_size=test_size),
+            eval_every=1 << 20)
+        dense_bytes = (tr._scatter_spec.bounds[-1]
+                       - tr._scatter_spec.bounds[0]) * 4
+        budget = lossy_budget_bytes(dense_bytes, workers)
+        del tr
+    budget_mb = budget / (1 << 20)
+
+    legs = {}
+    for name in ("scatter", "codec"):
+        legs[name] = _measure(
+            comm_modes_config(name, workers=workers,
+                              train_size=train_size, test_size=test_size,
+                              rounds=rounds, budget_mb=budget_mb),
+            rounds, rounds, repeats, max_spread=max_spread,
+            telemetry=telemetry)
+        print(f"# comm-modes {name}: "
+              f"{legs[name]['rounds_per_sec']:.4f} r/s (spread "
+              f"{legs[name]['spread_pct']:.1f}%, "
+              f"acc={legs[name]['avg_test_acc']:.4f})", file=sys.stderr)
+
+    def _converge(mode):
+        from dopt.engine import GossipTrainer
+
+        cfg = comm_modes_config(mode, workers=workers,
+                                train_size=train_size,
+                                test_size=test_size, rounds=conv_rounds,
+                                budget_mb=budget_mb, faults=True)
+        tr = GossipTrainer(cfg, eval_every=max(conv_rounds // 2, 1))
+        tr.run(rounds=conv_rounds, block=conv_rounds)
+        return [float(r["avg_train_loss"]) for r in tr.history.rows]
+
+    raw_losses = _converge("scatter")
+    codec_losses = _converge("codec")
+    target = raw_losses[-1] * 1.02
+
+    def _rounds_to(losses):
+        for i, v in enumerate(losses):
+            if v <= target:
+                return i + 1
+        return len(losses)
+
+    raw, codec = legs["scatter"], legs["codec"]
+    result = {
+        "metric": f"gossip_comm_codec_dsgd_mlp_{workers}workers",
+        "value": round(codec["rounds_per_sec"], 4),
+        "unit": "rounds/sec",
+        "workers": workers,
+        "rounds_per_block": rounds,
+        "device_kind": kind,
+        "compressed_rounds_per_sec": round(codec["rounds_per_sec"], 4),
+        "raw_scatter_rounds_per_sec": round(raw["rounds_per_sec"], 4),
+        "codec_overhead_pct": round(
+            100.0 * (1.0 - codec["rounds_per_sec"]
+                     / raw["rounds_per_sec"]), 2),
+        "budget_bytes": int(budget),
+        "target_avg_train_loss": round(target, 4),
+        "rounds_to_target_raw": _rounds_to(raw_losses),
+        "rounds_to_target_codec": _rounds_to(codec_losses),
+        "raw_final_train_loss": round(raw_losses[-1], 4),
+        "codec_final_train_loss": round(codec_losses[-1], 4),
+        "conv_rounds": conv_rounds,
+        "codec_avg_test_acc": round(codec["avg_test_acc"], 4),
+        "raw_avg_test_acc": round(raw["avg_test_acc"], 4),
+        "spread_pct": round(codec["spread_pct"], 2),
+        "samples_per_sec": round(codec["samples_per_sec"], 1),
+        "host_gap_pct": round(codec["host_gap_pct"], 2),
+    }
+    if probe is not None:
+        result.update({
+            "bytes_on_wire": float(probe["codec"]["total"]),
+            "dense_bytes_on_wire": float(probe["dense"]["total"]),
+            "scatter_bytes_on_wire": float(probe["scatter"]["total"]),
+            "wire_compression": probe["wire_compression"],
+            "plan_kinds": ",".join(probe["plan_kinds"]),
+            "plan_compression": probe["plan_compression"],
+            "probe_devices": probe["devices"],
+            "codec_bytes_by_dtype": probe["codec"]["by_dtype"],
+        })
+    else:
+        # Degraded basis: the in-process probe (0.0 on a 1-device
+        # mesh) plus the schedule's analytic compression — present and
+        # finite either way, flagged so a ledger reader knows which
+        # basis this row carries.
+        result["bytes_on_wire"] = codec["bytes_on_wire"]
+        result["probe_devices"] = 0
     return result
 
 
@@ -983,6 +1167,13 @@ def main() -> None:
                          "workload, plus the hbm-reuse donation proof "
                          "and the seqlm leg) and append their headlines "
                          "to the history ledger")
+    ap.add_argument("--comm-modes", action="store_true",
+                    help="run ONLY the r08 comm-substrate ablation "
+                         "(raw scatter vs the budgeted bucket codec at "
+                         "n=8: compiled-HLO bytes-on-wire, throughput, "
+                         "and rounds-to-target under the crash/churn "
+                         "cocktail) and append its headline to the "
+                         "history ledger")
     ap.add_argument("--run-id", default=None,
                     help="ledger run id for the history append "
                          "(default: derived from sha + timestamp)")
@@ -1100,6 +1291,35 @@ def main() -> None:
         _finish_telemetry(result)
         return
 
+    if args.comm_modes:
+        # Standalone r08 mode: the comm-substrate codec ablation only,
+        # its own ledger key (the r06/r07 pattern).  The HLO byte basis
+        # rides a subprocess so the probe mesh can be multi-device even
+        # when this process initialized a 1-device CPU backend.
+        c_rounds = args.rounds or (3 if args.smoke else 8)
+        c_repeats = 2 if args.smoke else args.repeats
+        tsize, esize = (2_048, 512) if args.smoke else (8_192, 1_024)
+        result = _measure_comm_modes(
+            train_size=tsize, test_size=esize, rounds=c_rounds,
+            repeats=c_repeats, telemetry=tele,
+            conv_rounds=6 if args.smoke else 24,
+            max_spread=0.0 if args.smoke else args.max_spread)
+        print(json.dumps(result))
+        if args.history_out and not args.smoke:
+            try:
+                from dopt.obs.regress import append_entry
+
+                entry = append_entry(args.history_out, result,
+                                     run_id=args.run_id)
+                print(f"# appended run {entry['run_id']} "
+                      f"(sha {entry['git_sha'] or 'unknown'}) to "
+                      f"{args.history_out}", file=sys.stderr)
+            except OSError as e:
+                print(f"# bench history append failed: {e}",
+                      file=sys.stderr)
+        _finish_telemetry(result)
+        return
+
     if args.quick:
         # CI-artifact mode: tiny data, two measured rounds per path —
         # enough to exercise both execution paths end to end and emit
@@ -1208,6 +1428,7 @@ def main() -> None:
         "samples_per_sec": round(fast_sps, 1),
         "model_tflops_per_sec": round(
             fast_sps * MODEL1_TRAIN_FLOPS_PER_SAMPLE / 1e12, 2),
+        "bytes_on_wire": fast["bytes_on_wire"],
     }
     if "device_ms_per_round" in fast:
         # Tunnel-immune basis: what the chip actually spent, from the

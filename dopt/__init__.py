@@ -22,6 +22,7 @@ Reference layer map: see SURVEY.md §1 in the repository root.
 """
 
 from dopt.config import (
+    CommConfig,
     DataConfig,
     ExperimentConfig,
     FaultConfig,
@@ -63,6 +64,7 @@ def __dir__():
 
 __all__ = [
     "from_reference_args",
+    "CommConfig",
     "DataConfig",
     "ExperimentConfig",
     "FaultConfig",

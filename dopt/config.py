@@ -648,6 +648,75 @@ class SeqLMConfig:
 
 
 @dataclass(frozen=True)
+class CommConfig:
+    """Communication substrate schedule (``dopt.parallel.collectives``).
+
+    One knob block shared by BOTH engines: which wire format each flat
+    bucket of the ``update_sharding='scatter'`` substrate speaks.  The
+    per-bucket schedule (``make_codec_plan``) maps a byte budget onto
+    formats — big conv/matmul buckets compress hardest (packed int8 or
+    nibble-packed int4 with per-chunk scales and error feedback),
+    small norm/bias buckets stay exact — and ``link_byte_budget``
+    derives that budget from the lossy-link fault model's goodput.
+    ``None`` on ExperimentConfig keeps every pre-change program
+    byte-identical (python-level gating)."""
+
+    codec: str = "none"
+    # Per-bucket integer codec: "none" | "qsgd" (per-chunk-scaled
+    # stochastic int8/int4, dopt.ops.compression.qint_encode).  The
+    # gossip engine carries the error-feedback residual as scan state
+    # ("comm_residual" in checkpoints); draws are stateless
+    # per-(round, bucket, global lane) fold-ins, so compressed runs are
+    # bit-reproducible, blocked-exact and resume-exact.
+    wire_dtype: str | None = None
+    # Dtype narrowing for buckets the codec does NOT cover (and for the
+    # whole wire when codec="none"): None | "bfloat16" | "float16".
+    byte_budget_mb: float = 0.0
+    # Per-lane per-round wire budget in MiB.  0 = no budget: every
+    # bucket at least min_codec_bytes large gets the codec at int8.
+    # > 0: buckets escalate largest-first (base -> q8 -> q4) until the
+    # schedule fits.  Use link_byte_budget(...) to derive it from a
+    # FaultConfig's msg_drop/msg_delay rates.
+    min_codec_bytes: int = 4096
+    # Buckets whose per-lane f32 payload is below this stay at the base
+    # wire format — compressing a bias vector saves nothing and costs a
+    # scale sidecar.
+    chunk: int = 1024
+    # Per-lane scale granularity of the integer codec (elements per
+    # f32 scale).  Must be even (int4 packs two levels per byte).
+    error_feedback: str = "on"
+    # "on" | "off": carry the per-bucket quantization residual and fold
+    # it back next round (DeepSqueeze/CHOCO error feedback — what keeps
+    # aggressive codecs convergent).  "off" drops the residual (an
+    # unbiased-codec-only mode for ablations).
+
+    def __post_init__(self) -> None:
+        if self.codec not in ("none", "qsgd"):
+            raise ValueError(
+                f"unknown comm codec {self.codec!r}; one of none|qsgd")
+        if self.wire_dtype not in (None, "bfloat16", "float16"):
+            raise ValueError(
+                f"unknown comm wire_dtype {self.wire_dtype!r}; one of "
+                "bfloat16|float16 (or None for the leaf dtype)")
+        if self.byte_budget_mb < 0:
+            raise ValueError(
+                f"comm byte_budget_mb must be >= 0, got "
+                f"{self.byte_budget_mb}")
+        if self.min_codec_bytes < 0:
+            raise ValueError(
+                f"comm min_codec_bytes must be >= 0, got "
+                f"{self.min_codec_bytes}")
+        if self.chunk <= 0 or self.chunk % 2:
+            raise ValueError(
+                f"comm chunk must be a positive even count, got "
+                f"{self.chunk}")
+        if self.error_feedback not in ("on", "off"):
+            raise ValueError(
+                f"unknown comm error_feedback {self.error_feedback!r}; "
+                "one of on|off")
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     """Top-level experiment description = the notebook form cell, typed."""
 
@@ -673,6 +742,10 @@ class ExperimentConfig:
     # sampling from a 1k–10k client population with hierarchical
     # (multi-wave) aggregation.  None = the classic worker==lane
     # engines, bit-identical to pre-population programs.
+    comm: CommConfig | None = None
+    # Communication substrate schedule: per-bucket wire codecs inside
+    # the scatter path (dopt.parallel.collectives.make_codec_plan).
+    # None = the uncompressed wire, bit-identical to pre-comm programs.
     # Execution backend — the pluggable Worker(backend=...) boundary:
     # "jax" runs the TPU/mesh engines; "torch" runs the SAME experiment
     # on the faithful sequential CPU oracle (dopt.engine.torch_backend)
@@ -797,7 +870,7 @@ def exp_details(cfg: ExperimentConfig) -> str:
     """Human-readable config dump (reference ``exp_details``, utils.py:147-165)."""
     lines = [f"Experiment: {cfg.name}", f"  seed      : {cfg.seed}", f"  backend   : {cfg.backend}"]
     for section in ("data", "model", "optim", "federated", "gossip", "faults",
-                    "robust", "population"):
+                    "robust", "population", "comm"):
         sub = getattr(cfg, section)
         if sub is None:
             continue

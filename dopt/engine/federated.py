@@ -192,12 +192,6 @@ class FederatedTrainer:
                     f"reduce; aggregator={aggregator!r} is a full-"
                     "precision robust statistic over whole updates — "
                     "drop one of the two")
-            if f.comm_dtype:
-                raise ValueError(
-                    "update_sharding='scatter' already restructures "
-                    "the aggregation wire path; comm_dtype applies to "
-                    "the plain masked-mean reduce only — drop one of "
-                    "the two")
             if f.staleness_max > 0:
                 raise ValueError(
                     "update_sharding='scatter' does not compose with "
@@ -221,6 +215,37 @@ class FederatedTrainer:
             # probing jax.default_backend() here would initialize the
             # backend and make the flags unappliable (see gossip.py).
             enable_latency_hiding_scheduler()
+
+        # Communication substrate schedule (ExperimentConfig.comm): the
+        # federated aggregation speaks the same flat-bucket scatter
+        # wire, so CommConfig.wire_dtype narrows the bucketed reduce
+        # hop exactly like gossip's.  The qsgd bucket codec stays a
+        # gossip-engine mode here: its error-feedback residual is
+        # per-ROUND carried worker state, and the federated round
+        # re-binds sampled clients onto lanes every round, so there is
+        # no stable lane for the residual to live on.
+        comm_cfg = cfg.comm
+        if comm_cfg is not None:
+            if not self._scatter:
+                raise ValueError(
+                    "the comm substrate schedule (ExperimentConfig.comm) "
+                    "speaks the flat-bucket wire of "
+                    "update_sharding='scatter'; set "
+                    "federated.update_sharding='scatter' to arm it "
+                    f"(got update_sharding={f.update_sharding!r})")
+            if comm_cfg.codec != "none":
+                raise ValueError(
+                    f"comm.codec={comm_cfg.codec!r} needs a stable "
+                    "per-lane error-feedback residual across rounds; "
+                    "the federated round re-binds sampled clients onto "
+                    "lanes, so run the codec on the gossip engine and "
+                    "use comm.wire_dtype for federated wire narrowing")
+            if f.comm_dtype and comm_cfg.wire_dtype:
+                raise ValueError(
+                    f"federated.comm_dtype={f.comm_dtype!r} and "
+                    f"comm.wire_dtype={comm_cfg.wire_dtype!r} both name "
+                    "a wire dtype; set exactly one (comm.wire_dtype is "
+                    "the substrate-schedule spelling of the same knob)")
 
         # Staleness-aware aggregation (FederatedConfig.staleness_max):
         # instead of hard-dropping a deadline-missed straggler
@@ -677,6 +702,8 @@ class FederatedTrainer:
         # runs reproduce multi-device numerics).
         agg_mesh = self.mesh
         agg_comm = jnp.dtype(f.comm_dtype) if f.comm_dtype else None
+        if cfg.comm is not None and cfg.comm.wire_dtype:
+            agg_comm = jnp.dtype(cfg.comm.wire_dtype)
         scatter_spec = self._scatter_spec
         rho = cfg.optim.rho
         lr = cfg.optim.lr
@@ -1010,7 +1037,8 @@ class FederatedTrainer:
                     new_theta = agg_robust(agg_in, agg_mask)
                 elif scatter_spec is not None:
                     new_theta = masked_average_scatter(
-                        agg_in, agg_mask, agg_mesh, scatter_spec)
+                        agg_in, agg_mask, agg_mesh, scatter_spec,
+                        comm_dtype=agg_comm)
                 else:
                     new_theta = masked_average(agg_in, agg_mask,
                                                mesh=agg_mesh,

@@ -42,8 +42,10 @@ from dopt.engine.local import (_stacked_eval_scan, flat_input_apply,
                                pick_gather_chunks, prepare_holdout,
                                validate_optimizer)
 from dopt.models import build_model, count_params
-from dopt.parallel.collectives import (make_update_shard_spec, mix_dense,
-                                        mix_shifts, mix_update_scatter,
+from dopt.parallel.collectives import (buckets_to_stacked, make_codec_plan,
+                                        make_update_shard_spec, mix_codec_gather,
+                                        mix_dense, mix_shifts,
+                                        mix_update_scatter, stacked_to_buckets,
                                         where_mask)
 from dopt.parallel.mesh import (make_worker_mesh, shard_over_workers,
                                 shard_worker_tree, worker_axes,
@@ -602,6 +604,51 @@ class GossipTrainer:
         mesh = self.mesh
         comm_dtype = jnp.dtype(g.comm_dtype) if g.comm_dtype else None
 
+        # Communication substrate schedule (ExperimentConfig.comm): the
+        # per-bucket wire codecs of dopt.parallel.collectives speak the
+        # flat-bucket scatter representation, so CommConfig requires
+        # update_sharding='scatter' — one substrate, one schedule,
+        # shared with the federated engine.  None python-gates every
+        # use below: default-off programs stay byte-identical.
+        comm_cfg = cfg.comm
+        codec_on = comm_cfg is not None and comm_cfg.codec != "none"
+        if comm_cfg is not None:
+            if g.update_sharding != "scatter":
+                raise ValueError(
+                    "the comm substrate schedule (ExperimentConfig.comm) "
+                    "speaks the flat-bucket wire of "
+                    "update_sharding='scatter'; set "
+                    "gossip.update_sharding='scatter' to arm it (got "
+                    f"update_sharding={g.update_sharding!r})")
+            if g.comm_dtype and comm_cfg.wire_dtype:
+                raise ValueError(
+                    f"gossip.comm_dtype={g.comm_dtype!r} and "
+                    f"comm.wire_dtype={comm_cfg.wire_dtype!r} both name "
+                    "a wire dtype; set exactly one (comm.wire_dtype is "
+                    "the substrate-schedule spelling of the same knob)")
+            if codec_on and g.algorithm not in ("dsgd", "gossip"):
+                raise ValueError(
+                    f"comm.codec={comm_cfg.codec!r} carries a per-bucket "
+                    "error-feedback residual across single-sweep "
+                    "consensus rounds; use algorithm dsgd|gossip "
+                    f"(got {g.algorithm!r}: fedlcon's eps sweeps would "
+                    "re-encode mid-round, choco already quantizes its "
+                    "own exchange, nocons|centralized|matching never "
+                    "run the bucket wire)")
+            if codec_on and g.comm_impl == "shift":
+                raise ValueError(
+                    "comm_impl='shift' ships circulant ppermute lanes; "
+                    "the bucket codec speaks the gathered-bucket wire — "
+                    "use comm_impl='auto'|'dense' with comm.codec")
+            if codec_on and cfg.population is not None:
+                raise ValueError(
+                    "comm.codec with population mode would hand lane "
+                    "i's quantization residual to a different client "
+                    "after a cohort rebinding; run the codec on the "
+                    "classic worker==lane engines (population=None)")
+            if comm_cfg.wire_dtype:
+                comm_dtype = jnp.dtype(comm_cfg.wire_dtype)
+
         # Consensus collective selection (GossipConfig.comm_impl): the
         # ppermute shift path replaces the reference's Neighbors()
         # state-dict passing (simulators.py:91-97) with O(k·|θ|) bytes of
@@ -625,7 +672,7 @@ class GossipTrainer:
                 "path (the 'auto' default picks it)")
         self._shift_ids: tuple[int, ...] | None = None
         if (g.comm_impl != "dense" and not robust_active
-                and not self._link_mode
+                and not self._link_mode and not codec_on
                 and self.mixing is not None and (do_mix or is_choco)):
             flat_1d = len(mesh.axis_names) == 1
             extra = (0,) if self.faults.affects_matrix else ()
@@ -685,12 +732,12 @@ class GossipTrainer:
                 "one of off|scatter")
         self._scatter_spec = None
         if g.update_sharding == "scatter":
-            if g.algorithm not in ("dsgd", "fedlcon", "gossip"):
+            if g.algorithm not in ("dsgd", "fedlcon", "gossip", "choco"):
                 raise ValueError(
                     "update_sharding='scatter' shards the consensus "
                     "mix; algorithm "
                     f"{g.algorithm!r} has no dense mixing step to "
-                    "shard (dsgd|fedlcon|gossip)")
+                    "shard (dsgd|fedlcon|gossip|choco)")
             if robust_active:
                 raise ValueError(
                     "update_sharding='scatter' does not compose with "
@@ -703,11 +750,6 @@ class GossipTrainer:
                     "link faults / push-sum (the per-staleness "
                     "[D+1, n, n] contraction carries its own buffers) "
                     "— drop one of the two")
-            if g.comm_dtype:
-                raise ValueError(
-                    "update_sharding='scatter' already restructures "
-                    "the wire path; comm_dtype compression applies to "
-                    "the plain collectives only — drop one of the two")
             if len(mesh.axis_names) != 1:
                 raise ValueError(
                     "update_sharding='scatter' needs a flat 1-D worker "
@@ -726,6 +768,36 @@ class GossipTrainer:
                 stacked, fold=mesh.size,
                 bucket_bytes=int(g.update_bucket_mb * (1 << 20)))
         scatter_spec = self._scatter_spec
+
+        # Per-bucket wire schedule + error-feedback residual.  The plan
+        # is compiled structure (built once from the spec); the residual
+        # is carried engine state ("comm_residual" in checkpoints) —
+        # round −1's residual is defined as zero, so codec round 0
+        # encodes exactly v = x.  Built from fresh zeros: round_fn
+        # donates the carry, and a donated input must never alias the
+        # init tree.
+        self._codec_plan = None
+        self._codec_on = codec_on
+        self._comm_res: object = ()
+        codec_plan = None
+        comm_key = None
+        comm_ef = True
+        if comm_cfg is not None and scatter_spec is not None:
+            self._codec_plan = make_codec_plan(
+                scatter_spec, codec=comm_cfg.codec,
+                wire_dtype=comm_cfg.wire_dtype,
+                byte_budget=int(comm_cfg.byte_budget_mb * (1 << 20)),
+                min_codec_bytes=comm_cfg.min_codec_bytes,
+                chunk=comm_cfg.chunk)
+            codec_plan = self._codec_plan
+            comm_ef = comm_cfg.error_feedback == "on"
+        if codec_on:
+            comm_key = jax.random.key(cfg.seed ^ 0xC0DEC)
+            widths = [b - a for a, b in zip(scatter_spec.bounds,
+                                            scatter_spec.bounds[1:])]
+            self._comm_res = shard_worker_tree(
+                tuple(np.zeros((w, wd), np.float32) for wd in widths),
+                self.mesh)
 
         # Asynchronous (staleness-1) gossip (GossipConfig.mixing): round
         # t's mix reads the PREVIOUS round's neighbor state — x_i ←
@@ -877,10 +949,28 @@ class GossipTrainer:
             or the [k, n] coefficient table (shift) for the round."""
             if scatter_spec is not None:
                 return mix_update_scatter(x, arg, mesh, scatter_spec,
-                                          shift_ids=shift_ids)
+                                          shift_ids=shift_ids,
+                                          comm_dtype=comm_dtype)
             if shift_ids is not None:
                 return mix_shifts(x, shift_ids, arg, mesh, comm_dtype)
             return mix_dense(x, arg, mesh, comm_dtype)
+
+        def codec_mix(params, cres, w_matrix, t):
+            """One compressed consensus sweep over the flat buckets:
+            per-bucket encode(v = x + e) → packed all-gather → local
+            decode → mixing-row contraction (mix_codec_gather), with
+            the quantization residual fed back next round.  Draws are a
+            pure function of (round, bucket, global lane) — fold-in
+            keyed, never split — so blocked, per-round, and resumed
+            runs encode identical bits."""
+            buckets = stacked_to_buckets(params, scatter_spec)
+            key = jax.random.fold_in(comm_key, t)
+            mixed, new_res = mix_codec_gather(buckets, list(cres),
+                                              w_matrix, mesh, codec_plan,
+                                              key)
+            if not comm_ef:
+                new_res = [jnp.zeros_like(r) for r in new_res]
+            return buckets_to_stacked(mixed, scatter_spec), tuple(new_res)
 
         def mix_consensus(x, arg):
             """eps sweeps (FedLCon, with the stale-accumulation bug
@@ -1160,7 +1250,7 @@ class GossipTrainer:
         def round_fn(params, mom, x_hat, w_matrix, alive, limits, t, idx,
                      bweight, train_x, train_y, ex, ey, ew, vidx, vw,
                      do_eval, cmask=None, quar=None, prev=None,
-                     wdiag=None, fbuf=None):
+                     wdiag=None, fbuf=None, cres=None):
             # Async: this round's ENTRY state is what the neighbors
             # read NEXT round — it becomes the new prev buffer.
             entry = params if prev is not None else None
@@ -1174,6 +1264,12 @@ class GossipTrainer:
                 # endpoint).
                 params = fused_mix_update(params, fbuf, w_matrix,
                                           fused_spec, lr=1.0)
+                screened = jnp.zeros(w, jnp.float32)
+            elif codec_on:
+                # Compressed wire: the codec replaces the round's one
+                # consensus sweep (eps==1 — the validation pins it) and
+                # threads the error-feedback residual carry.
+                params, cres = codec_mix(params, cres, w_matrix, t)
                 screened = jnp.zeros(w, jnp.float32)
             else:
                 params, x_hat, screened = consensus_phase(
@@ -1204,16 +1300,21 @@ class GossipTrainer:
                 # the lane freezes through the next repaired mix.
                 new_fbuf = jax.tree.map(lambda a, b: a - b, params, p_t)
                 return params, m_t, x_hat, new_fbuf, packed
+            if codec_on:
+                return p_t, m_t, x_hat, cres, packed
             if prev is not None:
                 return p_t, m_t, x_hat, entry, packed
             return p_t, m_t, x_hat, packed
 
-        # Donating the displacement buffer (fused runs only — the
-        # kwarg-name donation keeps the default path's jit params, and
-        # therefore its fingerprinted programs, byte-identical) lets
-        # XLA alias new_fbuf into fbuf's pages: the round carry costs
-        # zero extra HBM over the unfused path.
-        _fused_donate = {"donate_argnames": ("fbuf",)} if fused_on else {}
+        # Donating the displacement/residual buffers (armed runs only —
+        # the kwarg-name donation keeps the default path's jit params,
+        # and therefore its fingerprinted programs, byte-identical)
+        # lets XLA alias the new carry into the old carry's pages: the
+        # round carry costs zero extra HBM over the plain path.
+        _donate_names = (("fbuf",) if fused_on else ())
+        _donate_names += (("cres",) if codec_on else ())
+        _fused_donate = ({"donate_argnames": _donate_names}
+                         if _donate_names else {})
         self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2),
                                  **_fused_donate)
         self._sharding = worker_sharding(self.mesh)
@@ -1236,7 +1337,7 @@ class GossipTrainer:
         def block_fn(params, mom, x_hat, w_mats, alive, limits, ts, idx, bw,
                      is_eval, train_x, train_y, ex, ey, ew, vidx, vw,
                      cmasks=None, streak=None, until=None, prev=None,
-                     wdiags=None, fbuf=None):
+                     wdiags=None, fbuf=None, cres=None):
             """k rounds fused into one lax.scan dispatch (jit retraces per
             distinct k).  Each iteration is one full reference round with
             the SAME phase order as the per-round path — consensus →
@@ -1252,7 +1353,7 @@ class GossipTrainer:
             without surfacing flags to the host mid-block."""
 
             def body(carry, xs):
-                pv = wd_t = fb = None
+                pv = wd_t = fb = cr = None
                 if fused_quar:
                     p, m, xh, stk, unt = carry
                 elif is_async:
@@ -1265,6 +1366,11 @@ class GossipTrainer:
                     # Fused carry: p is the POST-MIX state q, fb the
                     # displacement to the post-local endpoint.
                     p, m, xh, fb = carry
+                    stk = unt = None
+                elif codec_on:
+                    # Codec carry: cr is the per-bucket error-feedback
+                    # residual the next round's encode folds back in.
+                    p, m, xh, cr = carry
                     stk = unt = None
                 else:
                     p, m, xh = carry
@@ -1290,6 +1396,9 @@ class GossipTrainer:
                                                           quar_t, cm_t)
                 if fused_on:
                     p = fused_mix_update(p, fb, w_t, fused_spec, lr=1.0)
+                    scr = jnp.zeros(w, jnp.float32)
+                elif codec_on:
+                    p, cr = codec_mix(p, cr, w_t, t_t)
                     scr = jnp.zeros(w, jnp.float32)
                 else:
                     p, xh, scr = consensus_phase(p, xh, w_t, alive_t, t_t,
@@ -1322,6 +1431,8 @@ class GossipTrainer:
                 if fused_on:
                     new_fb = jax.tree.map(lambda a, b: a - b, p, p_t)
                     return (p, m_t, xh, new_fb), packed
+                if codec_on:
+                    return (p_t, m_t, xh, cr), packed
                 return (p_t, m_t, xh), packed
 
             xs = [w_mats, alive, limits, ts, idx, bw, is_eval]
@@ -1335,6 +1446,8 @@ class GossipTrainer:
                 carry0 = (params, mom, x_hat, prev)
             elif fused_on:
                 carry0 = (params, mom, x_hat, fbuf)
+            elif codec_on:
+                carry0 = (params, mom, x_hat, cres)
             else:
                 carry0 = (params, mom, x_hat)
             carry, packed = jax.lax.scan(body, carry0, tuple(xs))
@@ -1346,6 +1459,9 @@ class GossipTrainer:
             if fused_on:
                 params, mom, x_hat, fbuf = carry
                 return params, mom, x_hat, fbuf, packed
+            if codec_on:
+                params, mom, x_hat, cres = carry
+                return params, mom, x_hat, cres, packed
             params, mom, x_hat = carry
             return params, mom, x_hat, packed
 
@@ -1662,6 +1778,8 @@ class GossipTrainer:
                                    wdiags=jnp.asarray(payload["wdiags"]))
                 if self._fused_on:
                     step_kw["fbuf"] = self._fused_buf
+                if self._codec_on:
+                    step_kw["cres"] = self._comm_res
                 fn = self._block_fn
                 args = (self.params, self.momentum, self.x_hat, *common)
             if stager is None:
@@ -1696,6 +1814,9 @@ class GossipTrainer:
             elif self._fused_on:
                 (self.params, self.momentum, self.x_hat,
                  self._fused_buf, packed) = out
+            elif self._codec_on:
+                (self.params, self.momentum, self.x_hat,
+                 self._comm_res, packed) = out
             else:
                 (self.params, self.momentum, self.x_hat, packed) = out
             packed = np.asarray(packed)  # ONE device→host fetch per block
@@ -2138,6 +2259,9 @@ class GossipTrainer:
             elif self._fused_on:
                 (self.params, self.momentum, self.x_hat,
                  self._fused_buf, packed) = out
+            elif self._codec_on:
+                (self.params, self.momentum, self.x_hat,
+                 self._comm_res, packed) = out
             else:
                 self.params, self.momentum, self.x_hat, packed = out
             tl, ta, acc, lm, scr, em, diag = self._unpack_host_metrics(
@@ -2234,6 +2358,8 @@ class GossipTrainer:
             step_kw["wdiag"] = jnp.asarray(wdiag)
         if self._fused_on:
             step_kw["fbuf"] = self._fused_buf
+        if self._codec_on:
+            step_kw["cres"] = self._comm_res
         args = (self.params, self.momentum, self.x_hat, w_t, alive,
                 limits, jnp.asarray(t, jnp.int32), idx, bweight,
                 self._train_x, self._train_y, *self._eval, *self._val,
@@ -2292,6 +2418,12 @@ class GossipTrainer:
             # endpoint — so a fused resume needs both trees to contract
             # round t exactly as the unkilled run would.
             arrays["fused_buf"] = self._fused_buf
+        if self._codec_on:
+            # The per-bucket error-feedback residual is carried engine
+            # state: a resumed codec run must fold back exactly the
+            # quantization error the unkilled run would have.
+            arrays["comm_residual"] = {
+                f"b{i}": r for i, r in enumerate(self._comm_res)}
         if self._link_mode:
             # Push-sum mass and the staleness buffers are carried engine
             # state: without them a resumed lossy-link run would replay
@@ -2357,6 +2489,24 @@ class GossipTrainer:
                 "— the checkpoint's 'params' are the post-mix state q, "
                 "not the post-local endpoint; restore with "
                 "fused_update='on'")
+        if self._codec_on:
+            if "comm_residual" not in arrays:
+                raise ValueError(
+                    "comm.codec trainer requires its per-bucket "
+                    "error-feedback residual ('comm_residual') in the "
+                    "checkpoint — this checkpoint is from an "
+                    "uncompressed run, whose rounds never accumulated "
+                    "a quantization error to feed back")
+            res = arrays["comm_residual"]
+            self._comm_res = shard_worker_tree(
+                tuple(res[f"b{i}"] for i in range(len(res))), self.mesh)
+        elif "comm_residual" in arrays:
+            raise ValueError(
+                "checkpoint carries a comm error-feedback residual "
+                "('comm_residual') but this trainer runs without the "
+                "bucket codec — the residual's pending correction "
+                "would be silently dropped; restore with the same "
+                "CommConfig codec armed")
         if self._link_mode:
             if self._push_sum:
                 if "push_mass" not in arrays:

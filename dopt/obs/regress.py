@@ -65,6 +65,12 @@ TRACKED_METRICS: dict[str, str] = {
     "fused_rounds_per_sec": "higher",
     "fused_speedup": "higher",
     "seqlm_tokens_per_sec": "higher",
+    # Comm-substrate headline (r08): compiled-HLO wire bytes of the
+    # round program (less is better — the codec's whole point) and the
+    # compressed leg's throughput (the codec must not buy bytes with
+    # a dispatch-bound round).  NO_BASELINE on first appearance.
+    "bytes_on_wire": "lower",
+    "compressed_rounds_per_sec": "higher",
 }
 
 

@@ -19,6 +19,23 @@ invariant is what the choco≡dsgd reduction test pins.  ``qsgd`` is a
 QUANTIZER with different ratio semantics: ratio sets the level count
 (ratio=1 → 256-level stochastic quantization, NOT the identity); use
 ``compression='none'`` for the exact D-SGD reduction.
+
+Key handling is STATELESS, FaultPlan-style: the caller folds the round
+into a base key once (``fold_in(base, t)``) and every leaf/lane draw
+here derives from it by a further ``fold_in`` on the leaf index (tree
+operators) or the GLOBAL worker-lane id (flat-slab codecs).  No split
+chains, no carried RNG state — the bits for (round, leaf/bucket, lane)
+are a pure function of those coordinates, which is what makes
+compressed runs bit-reproducible, blocked-exact and resume-exact, and
+what lets the sharded scatter path and the dense reference path draw
+IDENTICAL bits (each device folds its own global lane ids).
+
+The flat-slab codecs (``qint_encode``/``qint_decode``) are the wire
+format of the per-bucket communication substrate
+(``dopt.parallel.collectives.mix_codec_gather``): per-chunk max-abs
+scaled stochastic integer quantization at 8 or 4 bits, nibble-packed
+at 4 — the payload that actually crosses ICI/DCN is the int8/uint8
+level tensor plus the tiny f32 scale sidecar.
 """
 
 from __future__ import annotations
@@ -68,7 +85,7 @@ def rand_k_compress(tree, ratio: float, key):
     if ratio >= 1.0:
         return tree
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    keys = jax.random.split(key, len(leaves))
+    keys = [jax.random.fold_in(key, i) for i in range(len(leaves))]
 
     def comp(x, k_):
         w = x.shape[0]
@@ -101,7 +118,7 @@ def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048,
     (~√N · rms) and the noise swamps million-parameter models."""
     s = levels if levels else max(int(round(ratio * 256)), 1)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    keys = jax.random.split(key, len(leaves))
+    keys = [jax.random.fold_in(key, i) for i in range(len(leaves))]
 
     def comp(x, k):
         w = x.shape[0]
@@ -126,6 +143,101 @@ def qsgd_compress(tree, ratio: float, key, *, bucket_size: int = 2048,
 
     return jax.tree_util.tree_unflatten(
         treedef, [comp(x, k) for x, k in zip(leaves, keys)])
+
+
+# ---------------------------------------------------------------------
+# Flat-slab wire codecs (the per-bucket communication substrate)
+# ---------------------------------------------------------------------
+# Operate on [L, F] lane slabs (L worker lanes, F flat bucket elements
+# — the dopt.parallel.collectives UpdateShardSpec layout).  Per-chunk
+# max-abs scaling keeps the quantization step local (QSGD bucketing,
+# Alistarh et al. 2017); stochastic rounding keeps the codec unbiased;
+# per-GLOBAL-lane fold-in keys keep the draws identical whether a lane
+# is encoded on its owning device (shard_map) or in the dense
+# reference view.
+
+QINT_QMAX = {8: 127, 4: 7}
+
+
+def lane_fold_keys(key, lane_ids):
+    """[L] per-lane keys: ``fold_in(key, global_lane_id)`` vectorised.
+    ``lane_ids`` may be traced (``axis_index·L + arange(L)`` inside a
+    shard_map) — the bits depend only on (key, global lane), never on
+    the device that computes them."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(lane_ids)
+
+
+def _chunk_pad(f: int, chunk: int) -> tuple[int, int]:
+    nc = -(-f // chunk)
+    return nc, nc * chunk - f
+
+
+def qint_encode(v, lane_ids, key, *, chunk: int = 1024, bits: int = 8):
+    """Stochastically round a [L, F] f32 slab to ``bits``-bit integer
+    levels with per-(lane, chunk) max-abs scales.
+
+    Returns ``(payload, scale)`` — the two tensors that cross the wire:
+
+    * bits=8 — ``payload`` int8 [L, Fp] (levels in [-127, 127]),
+    * bits=4 — ``payload`` uint8 [L, Fp/2] (two sign-magnitude nibbles
+      per byte, level + 8 biased into [1, 15]),
+
+    with ``scale`` f32 [L, Fp/chunk] and Fp = F rounded up to a chunk
+    multiple (``chunk`` must be even so nibble pairs never straddle).
+    Rounding is unbiased: level = floor(v/scale + u) with u ~ U[0, 1)
+    drawn from the per-global-lane fold-in key, and |v/scale| ≤ qmax by
+    construction so the clip never bites."""
+    if bits not in QINT_QMAX:
+        raise ValueError(f"qint codec supports bits in {{8, 4}}, got {bits}")
+    if chunk % 2:
+        raise ValueError(f"qint chunk must be even, got {chunk}")
+    qmax = QINT_QMAX[bits]
+    l, f = v.shape
+    nc, pad = _chunk_pad(f, chunk)
+    vf = v.astype(jnp.float32)
+    if pad:
+        vf = jnp.pad(vf, ((0, 0), (0, pad)))
+    bk = vf.reshape(l, nc, chunk)
+    scale = jnp.abs(bk).max(axis=2) / qmax                 # [L, nc]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = bk / safe[:, :, None]                              # |y| <= qmax
+    keys = lane_fold_keys(key, lane_ids)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (nc, chunk)))(keys)
+    lv = jnp.clip(jnp.floor(y + u), -qmax, qmax).astype(jnp.int32)
+    lv = lv.reshape(l, nc * chunk)
+    if bits == 8:
+        return lv.astype(jnp.int8), scale
+    biased = (lv + 8).astype(jnp.uint8)                    # [1, 15]
+    packed = biased[:, 0::2] | (biased[:, 1::2] << 4)
+    return packed, scale
+
+
+def qint_decode(payload, scale, f: int, *, chunk: int = 1024,
+                bits: int = 8, out_dtype=jnp.float32):
+    """Inverse of ``qint_encode``: levels · per-chunk scale, sliced back
+    to the true bucket width ``f``.  Works on gathered payloads too —
+    the leading axis is whatever the wire carried ([L] local or [n]
+    fleet-wide)."""
+    if bits == 8:
+        lv = payload.astype(jnp.float32)
+    else:
+        lo = (payload & 0xF).astype(jnp.int32)
+        hi = ((payload >> 4) & 0xF).astype(jnp.int32)
+        lv = jnp.stack([lo, hi], axis=-1).reshape(
+            payload.shape[0], -1).astype(jnp.float32) - 8.0
+    nc = scale.shape[-1]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    bk = lv.reshape(lv.shape[0], nc, -1) * safe[:, :, None]
+    return bk.reshape(lv.shape[0], nc * bk.shape[2])[:, :f].astype(out_dtype)
+
+
+def qint_wire_bytes(f: int, *, chunk: int = 1024, bits: int = 8) -> int:
+    """Per-lane wire bytes of one encoded bucket: the packed level
+    payload plus the f32 scale sidecar (the analytic mirror of what
+    ``hlo_collective_bytes`` measures from the compiled program)."""
+    nc, pad = _chunk_pad(f, chunk)
+    fp = f + pad
+    return fp * bits // 8 + nc * 4
 
 
 def make_compressor(name: str, ratio: float, *, qsgd_levels: int = 0):

@@ -442,7 +442,7 @@ def _require_flat_mesh(mesh: Mesh | None, what: str) -> str:
     return mesh.axis_names[0]
 
 
-def mix_dense_scatter(buckets, w_matrix, mesh: Mesh):
+def mix_dense_scatter(buckets, w_matrix, mesh: Mesh, comm_dtype=None):
     """Reduce-scatter formulation of ``mix_dense`` over flat buckets:
     each device contracts the mixing matrix's columns for ITS lanes
     against its local [L, Fb] slab (a partial sum of the true output for
@@ -458,7 +458,14 @@ def mix_dense_scatter(buckets, w_matrix, mesh: Mesh):
     parity contract); for bf16 trees it is strictly MORE precise than
     the dense path, which casts the matrix to bf16 and contracts at the
     leaf dtype — so bf16 scatter-vs-dense deltas include that matrix
-    quantization (~1e-3/row), not just reassociation."""
+    quantization (~1e-3/row), not just reassociation.
+
+    ``comm_dtype`` narrows the PARTIAL sums for the ``psum_scatter``
+    hop (the only bytes on the wire) and upcasts on arrival.  Unlike
+    the dense path's gather-then-sum, the reduce-scatter accumulates AT
+    the wire dtype across devices — one quantization per partial plus a
+    narrow-dtype add chain of depth log(D), the documented cost of
+    halving the scatter path's wire bytes."""
     ax = _require_flat_mesh(mesh, "update_sharding='scatter'")
     w = jnp.asarray(w_matrix, dtype=jnp.float32)
 
@@ -467,6 +474,8 @@ def mix_dense_scatter(buckets, w_matrix, mesh: Mesh):
         # x: [L, Fb] local lane slab.
         part = jnp.tensordot(w_cols, x.astype(jnp.float32),
                              axes=[[1], [0]])          # [n, Fb] partial
+        if comm_dtype is not None:
+            part = part.astype(comm_dtype)
         own = jax.lax.psum_scatter(part, ax, scatter_dimension=0,
                                    tiled=True)         # [L, Fb] mine
         return own.astype(x.dtype)
@@ -479,24 +488,30 @@ def mix_dense_scatter(buckets, w_matrix, mesh: Mesh):
 
 
 def mix_update_scatter(stacked, arg, mesh: Mesh, spec: UpdateShardSpec,
-                       shift_ids=None):
+                       shift_ids=None, comm_dtype=None):
     """The engine-facing scatter-mode consensus step: flatten the
     stacked tree into the spec's buckets, mix every bucket (dense
     reduce-scatter, or the sharded circulant contraction when the
     schedule decomposed into shifts — ``mix_shifts`` over flat buckets
     ships the SAME lane unions per rotation, just as size-bounded flat
-    chunks instead of per-leaf payloads), and restore the tree."""
+    chunks instead of per-leaf payloads), and restore the tree.
+
+    ``comm_dtype`` narrows the wire hop of whichever collective runs:
+    the ppermute payloads on the shift path, the reduce-scatter
+    partials on the dense path — the same one-knob wire compression the
+    plain (unsharded) collectives expose."""
     buckets = stacked_to_buckets(stacked, spec)
     if shift_ids is not None:
         with jax.named_scope("dopt_mix"):
-            mixed = mix_shifts(buckets, shift_ids, arg, mesh)
+            mixed = mix_shifts(buckets, shift_ids, arg, mesh, comm_dtype)
     else:
-        mixed = mix_dense_scatter(buckets, arg, mesh)
+        mixed = mix_dense_scatter(buckets, arg, mesh, comm_dtype)
     return buckets_to_stacked(mixed, spec)
 
 
 def masked_average_scatter(stacked, mask, mesh: Mesh,
-                           spec: UpdateShardSpec, denom=None):
+                           spec: UpdateShardSpec, denom=None,
+                           comm_dtype=None):
     """Sharded-update formulation of ``masked_average`` (Xu et al.,
     arXiv:2004.13336): each device reduces its local lanes' masked
     partial sum per bucket, ``psum_scatter`` leaves each device owning
@@ -510,7 +525,13 @@ def masked_average_scatter(stacked, mask, mesh: Mesh,
     per-lane weighted sums over multiple cohort WAVES and then needs
     Σ_lanes acc / total_cohort_weight — the lane mask alone no longer
     knows the true weight, so the caller supplies it (already guarded
-    against zero)."""
+    against zero).
+
+    ``comm_dtype`` narrows the reduce hop (the psum_scatter of the
+    masked partials) — accumulation happens AT the wire dtype across
+    devices, mirroring ``mix_dense_scatter``; the 1/D update divide and
+    the re-forming all-gather stay at the leaf dtype so θ itself is
+    never narrowed twice."""
     ax = _require_flat_mesh(mesh, "update_sharding='scatter'")
     m = jnp.asarray(mask, dtype=jnp.float32)
     denom = (jnp.maximum(m.sum(), 1.0) if denom is None
@@ -520,10 +541,12 @@ def masked_average_scatter(stacked, mask, mesh: Mesh,
     def per_device(mask_l, x):
         mm = mask_l.reshape((-1,) + (1,) * (x.ndim - 1))
         part = (x.astype(jnp.float32) * mm).sum(axis=0)     # [Fb] partial
+        if comm_dtype is not None:
+            part = part.astype(comm_dtype)
         shard = jax.lax.psum_scatter(part, ax, scatter_dimension=0,
                                      tiled=True)            # [Fb/D] mine
         with jax.named_scope("dopt_update"):
-            upd = (shard / denom).astype(x.dtype)           # 1/D update
+            upd = (shard.astype(jnp.float32) / denom).astype(x.dtype)
         return jax.lax.all_gather(upd, ax, axis=0, tiled=True)
 
     # all_gather of identical shards IS replicated but cannot be
@@ -535,6 +558,232 @@ def masked_average_scatter(stacked, mask, mesh: Mesh,
     with jax.named_scope("dopt_mix"):
         out = [fn(m, b) for b in buckets]
     return buckets_to_tree(out, spec)
+
+
+# ---------------------------------------------------------------------
+# Per-bucket wire codecs (CommConfig): the communication substrate
+# ---------------------------------------------------------------------
+# Every compressed mode now speaks the SAME flat-bucket representation
+# the scatter path already uses: a bucket's [L, Fb] lane slab is
+# encoded (dopt.ops.compression.qint_encode — per-chunk-scaled
+# stochastic int8, or nibble-packed int4), the PACKED payload is what
+# crosses the wire, each device decodes the gathered fleet payloads
+# locally and contracts its own mixing-matrix rows.  A reduce-scatter
+# cannot sum packed payloads, so the codec path is a compressed
+# all-gather formulation: wire bytes drop from the dense path's
+# 4·|bucket| f32 to |bucket|·bits/8 + the f32 scale sidecar (~4x at
+# int8, ~7.9x at int4), at the cost of materialising the decoded
+# [n, Fb] slab per bucket — the classic compression/memory trade the
+# bandwidth schedule only takes on buckets worth compressing.
+#
+# Error feedback (DeepSqueeze/CHOCO-style): v = x + e is encoded, the
+# residual e' = v − decode(encode(v)) stays local and re-enters next
+# round, so the quantization error is fed back instead of compounding
+# — the convergence-preserving half of the contract.  The residual is
+# carried scan state in the engines and checkpointed ("comm_residual").
+
+_WIRE_KINDS = ("raw", "bf16", "f16", "q8", "q4")
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketCodecPlan:
+    """Static per-bucket wire schedule for an ``UpdateShardSpec``.
+
+    ``kinds[i]`` names bucket i's wire format: ``raw`` (leaf dtype,
+    the exact scatter path), ``bf16``/``f16`` (dtype narrowing),
+    ``q8``/``q4`` (packed integer codec with error feedback).  Built
+    once at trainer construction by ``make_codec_plan`` — the schedule
+    is compiled structure, never data."""
+
+    kinds: tuple[str, ...]
+    chunk: int
+    dense_bytes: int   # per-lane f32 wire bytes of the whole tree/round
+    wire_bytes: int    # per-lane scheduled wire bytes of the same
+
+    @property
+    def any_codec(self) -> bool:
+        return any(k in ("q8", "q4") for k in self.kinds)
+
+    @property
+    def compression(self) -> float:
+        return self.dense_bytes / max(self.wire_bytes, 1)
+
+
+def _bucket_wire_bytes(width: int, kind: str, chunk: int) -> int:
+    from dopt.ops.compression import qint_wire_bytes
+
+    if kind == "raw":
+        return width * 4
+    if kind in ("bf16", "f16"):
+        return width * 2
+    return qint_wire_bytes(width, chunk=chunk,
+                           bits=8 if kind == "q8" else 4)
+
+
+def make_codec_plan(spec: UpdateShardSpec, *, codec: str = "none",
+                    wire_dtype=None, byte_budget: int = 0,
+                    min_codec_bytes: int = 4096,
+                    chunk: int = 1024) -> BucketCodecPlan:
+    """Map a byte budget onto per-bucket wire formats.
+
+    Base format: ``wire_dtype`` narrowing (or ``raw``).  With a codec
+    armed and no budget, every bucket whose per-lane f32 payload is at
+    least ``min_codec_bytes`` gets the codec — small norm/bias buckets
+    stay exact, the big conv/matmul slabs compress.  With
+    ``byte_budget`` > 0 (per lane per round, e.g. from
+    ``link_byte_budget``) buckets are escalated LARGEST FIRST —
+    base → q8 → q4 — until the total fits the budget or every eligible
+    bucket is at q4; large buckets therefore always compress at least
+    as hard as small ones, and the schedule degrades gracefully when
+    the budget is unreachable."""
+    if codec not in ("none", "qsgd"):
+        raise ValueError(f"unknown comm codec {codec!r}; one of none|qsgd")
+    base = {None: "raw", "bfloat16": "bf16", "float16": "f16"}.get(
+        str(wire_dtype) if wire_dtype is not None else None)
+    if base is None:
+        raise ValueError(
+            f"unknown comm wire_dtype {wire_dtype!r}; one of "
+            "bfloat16|float16 (or None for the leaf dtype)")
+    widths = [b - a for a, b in zip(spec.bounds, spec.bounds[1:])]
+    dense = sum(w * 4 for w in widths)
+    kinds = [base] * len(widths)
+    eligible = [i for i, w in enumerate(widths)
+                if codec != "none" and w * 4 >= min_codec_bytes]
+    by_size = sorted(eligible, key=lambda i: -widths[i])
+    if codec != "none" and byte_budget <= 0:
+        for i in eligible:
+            kinds[i] = "q8"
+    elif codec != "none":
+        def total():
+            return sum(_bucket_wire_bytes(w, k, chunk)
+                       for w, k in zip(widths, kinds))
+
+        for tier in ("q8", "q4"):
+            for i in by_size:
+                if total() <= byte_budget:
+                    break
+                kinds[i] = tier
+    wire = sum(_bucket_wire_bytes(w, k, chunk)
+               for w, k in zip(widths, kinds))
+    return BucketCodecPlan(kinds=tuple(kinds), chunk=int(chunk),
+                           dense_bytes=int(dense), wire_bytes=int(wire))
+
+
+def link_byte_budget(dense_bytes: int, *, msg_drop: float = 0.0,
+                     msg_delay: float = 0.0,
+                     msg_delay_max: int = 0) -> int:
+    """Per-link per-round byte budget implied by a lossy-link model
+    (``FaultConfig.msg_drop``/``msg_delay``/``msg_delay_max``): a link
+    that loses fraction p of its messages and delays fraction q of the
+    rest by up to D rounds delivers useful bytes at goodput factor
+    (1 − p) / (1 + q·D) of its raw rate — so a round's exchange only
+    fits the round if the payload shrinks by that factor.  This is the
+    bandwidth-aware schedule's input: the model that MOTIVATES
+    compression prices it."""
+    p = min(max(float(msg_drop), 0.0), 0.99)
+    q = min(max(float(msg_delay), 0.0), 1.0)
+    d = max(int(msg_delay_max), 0)
+    factor = (1.0 - p) / (1.0 + q * d)
+    return max(int(dense_bytes * factor), 1)
+
+
+def _codec_mix_bucket(w_rows, x, e, lane0, kind: str, chunk: int, key,
+                      ax: str | None):
+    """One bucket's compressed-gather mix on ONE device (or the dense
+    reference when ``ax`` is None): encode v = x + e per local lane,
+    gather the packed payloads, decode the fleet slab, contract this
+    device's mixing rows.  Returns (mixed [L, Fb], residual' [L, Fb]).
+
+    The encode keys fold the GLOBAL lane id, so the bits for lane i are
+    identical whether i is encoded here (shard_map) or in the reference
+    — the scatter-vs-dense parity contract for stochastic codecs."""
+    from dopt.ops.compression import qint_decode, qint_encode
+
+    l, fb = x.shape
+    bits = 8 if kind == "q8" else 4
+    lane_ids = lane0 + jnp.arange(l)
+    v = x.astype(jnp.float32) + e
+    payload, scale = qint_encode(v, lane_ids, key, chunk=chunk, bits=bits)
+    vq = qint_decode(payload, scale, fb, chunk=chunk, bits=bits)
+    new_e = v - vq
+    if ax is not None:
+        payload = jax.lax.all_gather(payload, ax, axis=0, tiled=True)
+        scale = jax.lax.all_gather(scale, ax, axis=0, tiled=True)
+        vg = qint_decode(payload, scale, fb, chunk=chunk, bits=bits)
+    else:
+        vg = vq
+    y = jnp.tensordot(w_rows, vg, axes=[[1], [0]])        # [L, Fb]
+    return y.astype(x.dtype), new_e
+
+
+def mix_codec_gather(buckets, residuals, w_matrix, mesh: Mesh,
+                     plan: BucketCodecPlan, key):
+    """Compressed consensus over flat buckets: per-bucket encode →
+    all-gather of the PACKED payload (+ f32 scale sidecar) →
+    local decode → this device's mixing rows contracted against the
+    decoded fleet slab.  ``raw``/narrowed buckets keep the exact
+    reduce-scatter path (``mix_dense_scatter``) — the codec only
+    replaces the wire where the schedule says it pays.
+
+    ``key`` is the round-folded base key; bucket i folds its index on
+    top, and the per-lane fold happens inside the encode — draws are a
+    pure function of (round, bucket, global lane).  Returns
+    ``(mixed_buckets, new_residuals)`` with residuals of codec buckets
+    updated (v − decode(encode(v))) and others passed through."""
+    ax = _require_flat_mesh(mesh, "comm codec")
+    w = jnp.asarray(w_matrix, dtype=jnp.float32)
+    n = w.shape[0]
+    lanes = n // mesh.size
+    mixed, new_res = [], []
+    with jax.named_scope("dopt_mix"):
+        for i, (b, e, kind) in enumerate(
+                zip(buckets, residuals, plan.kinds)):
+            if kind in ("q8", "q4"):
+                bkey = jax.random.fold_in(key, i)
+
+                def per_device(w_rows, x, er, _kind=kind, _bkey=bkey):
+                    lane0 = jax.lax.axis_index(ax) * lanes
+                    return _codec_mix_bucket(w_rows, x, er, lane0, _kind,
+                                             plan.chunk, _bkey, ax)
+
+                fn = compat_shard_map(
+                    per_device, mesh=mesh,
+                    in_specs=(P(ax, None), P(ax), P(ax)),
+                    out_specs=(P(ax), P(ax)))
+                y, e2 = fn(w, b, e)
+                mixed.append(y)
+                new_res.append(e2)
+            else:
+                cd = {"raw": None, "bf16": jnp.bfloat16,
+                      "f16": jnp.float16}[kind]
+                mixed.append(mix_dense_scatter([b], w, mesh, cd)[0])
+                new_res.append(e)
+    return mixed, new_res
+
+
+def mix_codec_reference(buckets, residuals, w_matrix,
+                        plan: BucketCodecPlan, key):
+    """Dense (no-mesh) reference of ``mix_codec_gather`` — the global
+    [W, Fb] view with lane ids 0..W−1, drawing the SAME per-lane bits.
+    The parity oracle for tests: sharded and reference paths agree to
+    f32 tolerance (bit-equal encodes; the contraction differs only by
+    gather layout)."""
+    w = jnp.asarray(w_matrix, dtype=jnp.float32)
+    mixed, new_res = [], []
+    for i, (b, e, kind) in enumerate(zip(buckets, residuals, plan.kinds)):
+        if kind in ("q8", "q4"):
+            y, e2 = _codec_mix_bucket(w, b, e, 0, kind, plan.chunk,
+                                      jax.random.fold_in(key, i), None)
+            mixed.append(y)
+            new_res.append(e2)
+        else:
+            cd = {"raw": None, "bf16": jnp.bfloat16,
+                  "f16": jnp.float16}[kind]
+            x = b if cd is None else b.astype(cd).astype(jnp.float32)
+            y = jnp.tensordot(w, x.astype(jnp.float32), axes=[[1], [0]])
+            mixed.append(y.astype(b.dtype))
+            new_res.append(e)
+    return mixed, new_res
 
 
 # ---------------------------------------------------------------------
@@ -564,10 +813,24 @@ def _shape_bytes(shape_text: str) -> int:
     return total
 
 
-def hlo_collective_bytes(hlo_text: str) -> dict[str, int]:
+def _shape_bytes_by_dtype(shape_text: str) -> dict[str, int]:
+    by: dict[str, int] = {}
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _HLO_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        by[dtype] = by.get(dtype, 0) + n * _HLO_BYTES[dtype]
+    return by
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict:
     """Count the result-buffer bytes of every cross-device collective in
     a compiled HLO dump (``jit(fn).lower(...).compile().as_text()``):
-    ``{op_kind: bytes, ..., "total": bytes}``.
+    ``{op_kind: bytes, ..., "total": bytes, "by_dtype": {dtype: bytes},
+    "by_op_dtype": {op_kind: {dtype: bytes}}}``.
 
     This is the measured basis for comm-volume claims — e.g. the folded
     shift path's "2 lane-shards per device vs the dense all_gather's
@@ -575,8 +838,16 @@ def hlo_collective_bytes(hlo_text: str) -> dict[str, int]:
     programs, not the docstring).  Result-buffer bytes upper-bound wire
     bytes proportionally (an all-gather's result includes the local
     shard), which cancels in path-vs-path comparisons.  Async pairs
-    (``*-start``/``*-done``) are counted once, at the start op."""
-    out: dict[str, int] = {k: 0 for k in _HLO_COLLECTIVES}
+    (``*-start``/``*-done``) are counted once, at the start op.
+
+    The per-dtype attribution is what makes COMPRESSED wires auditable:
+    a ``comm_dtype='bfloat16'`` run shows its gather bytes under
+    ``bf16``, a packed int8/int4 codec run under ``s8``/``u8`` with the
+    f32 scale sidecars accounted separately — so "4x fewer bytes" is a
+    statement about the compiled program, not the docstring."""
+    out: dict = {k: 0 for k in _HLO_COLLECTIVES}
+    by_dtype: dict[str, int] = {}
+    by_op: dict[str, dict[str, int]] = {k: {} for k in _HLO_COLLECTIVES}
     for line in hlo_text.splitlines():
         if "=" not in line:
             continue
@@ -584,9 +855,15 @@ def hlo_collective_bytes(hlo_text: str) -> dict[str, int]:
         for kind in _HLO_COLLECTIVES:
             m = re.search(rf"(^|\s){re.escape(kind)}(-start)?\(", rhs)
             if m:
-                out[kind] += _shape_bytes(rhs[:m.start()])
+                per = _shape_bytes_by_dtype(rhs[:m.start()])
+                for dt, b in per.items():
+                    out[kind] += b
+                    by_dtype[dt] = by_dtype.get(dt, 0) + b
+                    by_op[kind][dt] = by_op[kind].get(dt, 0) + b
                 break
     out["total"] = sum(out[k] for k in _HLO_COLLECTIVES)
+    out["by_dtype"] = by_dtype
+    out["by_op_dtype"] = {k: v for k, v in by_op.items() if v}
     return out
 
 

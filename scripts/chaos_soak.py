@@ -55,9 +55,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-from dopt.config import (DataConfig, ExperimentConfig, FaultConfig,  # noqa: E402
-                         FederatedConfig, GossipConfig, ModelConfig,
-                         OptimizerConfig)
+from dopt.config import (CommConfig, DataConfig, ExperimentConfig,  # noqa: E402
+                         FaultConfig, FederatedConfig, GossipConfig,
+                         ModelConfig, OptimizerConfig)
 from dopt.faults import KINDS  # noqa: E402
 
 _DATA = DataConfig(dataset="synthetic", num_users=8, iid=True,
@@ -66,18 +66,22 @@ _MODEL = ModelConfig(model="mlp", input_shape=(28, 28, 1), faithful=False)
 _OPTIM = OptimizerConfig(lr=0.1, momentum=0.5)
 
 
-def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig, FaultConfig]:
+def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig, FaultConfig,
+                                 FaultConfig]:
     """Seeded random draw of the round's fault cocktail: (gossip
-    cocktail, federated cocktail, async-gossip cocktail).  The
-    federated one adds the Byzantine nan liar (screened by the
-    always-on non-finite guard) and the heavy straggler deadline that
-    staleness-aware aggregation buffers; the gossip one leans on the
-    link model + push-sum.  The async one draws only the process
+    cocktail, federated cocktail, async-gossip cocktail, codec-gossip
+    cocktail).  The federated one adds the Byzantine nan liar (screened
+    by the always-on non-finite guard) and the heavy straggler deadline
+    that staleness-aware aggregation buffers; the gossip one leans on
+    the link model + push-sum.  The async one draws only the process
     faults (crash + straggler + churn) at HIGHER rates: link faults
     and push-sum are rejected by ``mixing='async'`` by design (the
     [D+1, n, n] staleness stack already subsumes staleness-1), so the
     storm concentrates on the repairs the diag/off-diag split must
-    survive."""
+    survive.  The codec one likewise draws only process faults — the
+    ``msg_*`` knobs run the per-staleness link engine, which keeps the
+    dense wire the bucket codec replaces — so the compression-armed leg
+    storms exactly the faults the scatter+codec path composes with."""
     rng = np.random.default_rng([0xC0C7A11, seed])
 
     def u(lo, hi):
@@ -96,7 +100,10 @@ def cocktail(seed: int) -> tuple[FaultConfig, FaultConfig, FaultConfig]:
     asynk = FaultConfig(
         crash=u(0.08, 0.18), straggle=u(0.1, 0.3), straggle_frac=0.5,
         churn=u(0.05, 0.12), churn_span=int(rng.integers(2, 4)))
-    return gossip, fed, asynk
+    codec = FaultConfig(
+        crash=u(0.03, 0.1), straggle=u(0.1, 0.3), straggle_frac=0.5,
+        churn=u(0.02, 0.08), churn_span=int(rng.integers(2, 4)))
+    return gossip, fed, asynk, codec
 
 
 def build_cfg(engine: str, seed: int, rounds: int,
@@ -106,7 +113,7 @@ def build_cfg(engine: str, seed: int, rounds: int,
     # thereby pin the NEW per-round convergence gauges too — the PR 8/10
     # guarantee extended to the diagnostics layer.
     pf = "on" if prefetch else "off"
-    gossip_fc, fed_fc, async_fc = cocktail(seed)
+    gossip_fc, fed_fc, async_fc, codec_fc = cocktail(seed)
     if engine == "gossip":
         return ExperimentConfig(
             name=f"chaos-gossip-{seed}", seed=100 + seed, data=_DATA,
@@ -132,6 +139,24 @@ def build_cfg(engine: str, seed: int, rounds: int,
                                 mixing="async", prefetch=pf,
                                 diagnostics="on"),
             faults=async_fc)
+    if engine == "gossip-codec":
+        # The compression-armed leg: scatter substrate + the per-bucket
+        # qsgd codec (error feedback riding the scan carry), under the
+        # process-fault storm it composes with.  Every soak invariant
+        # applies unchanged — blocked-vs-per-round bit-identity pins
+        # the codec's fold-in key stream + EF residual carry, and the
+        # kill-and-resume leg exercises the 'comm_residual' checkpoint
+        # payload end to end.
+        return ExperimentConfig(
+            name=f"chaos-gossip-codec-{seed}", seed=100 + seed,
+            data=_DATA, model=_MODEL, optim=_OPTIM,
+            gossip=GossipConfig(algorithm="dsgd", topology="circle",
+                                mode="metropolis", rounds=rounds,
+                                local_ep=1, local_bs=32,
+                                update_sharding="scatter", prefetch=pf,
+                                diagnostics="on"),
+            comm=CommConfig(codec="qsgd", chunk=64, min_codec_bytes=256),
+            faults=codec_fc)
     return ExperimentConfig(
         name=f"chaos-fed-{seed}", seed=100 + seed, data=_DATA,
         model=_MODEL, optim=_OPTIM,
@@ -462,11 +487,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="cocktail seed (each seed is a different storm)")
     ap.add_argument("--engine",
                     choices=["all", "both", "gossip", "gossip-async",
-                             "federated"],
+                             "gossip-codec", "federated"],
                     default="all",
-                    help="'all' runs the sync-gossip, async-gossip and "
-                         "federated legs; 'both' is the legacy "
-                         "sync-gossip + federated pair")
+                    help="'all' runs the sync-gossip, async-gossip, "
+                         "codec-gossip and federated legs; 'both' is "
+                         "the legacy sync-gossip + federated pair")
     ap.add_argument("--tol", type=float, default=0.0,
                     help="slack added to the final-loss-beats-first check")
     ap.add_argument("--kill", action="store_true",
@@ -505,7 +530,8 @@ def main(argv: list[str] | None = None) -> int:
 
     import tempfile
 
-    engines = {"all": ["gossip", "gossip-async", "federated"],
+    engines = {"all": ["gossip", "gossip-async", "gossip-codec",
+                       "federated"],
                "both": ["gossip", "federated"]}.get(args.engine,
                                                     [args.engine])
     metrics_sink = None

@@ -8,9 +8,12 @@ Contract (ISSUE 5 tentpole):
   (allclose, NOT bit-equal: reduce-scatter reassociates the sum), and
   scatter-vs-scatter is bit-reproducible, blocked-exact and
   resume-exact.
-* Ineligible compositions (robust layer, link faults/push-sum, choco,
-  comm_dtype, staleness, compact, hybrid meshes) are rejected LOUDLY
-  at trainer construction — never silently run a different experiment.
+* Ineligible compositions (robust layer, link faults/push-sum,
+  staleness, compact, hybrid meshes) are rejected LOUDLY at trainer
+  construction — never silently run a different experiment.  The
+  comm_dtype/choco wire-treatment rejections were LIFTED by the
+  communication substrate (tests/test_comm_substrate.py pins the
+  composed behaviour).
 
 Collective-level tests run on the 8-device virtual CPU mesh; engine
 tests use the tiny synthetic MLP configs from ``test_engine``.  The
@@ -309,9 +312,11 @@ def test_scatter_rejections(devices):
     with pytest.raises(ValueError, match="link faults"):
         GossipTrainer(_gossip_sc().replace(
             faults=FaultConfig(msg_drop=0.2)))
-    with pytest.raises(ValueError, match="comm_dtype"):
-        GossipTrainer(_gossip_sc(
-            gossip={"comm_dtype": "bfloat16", "update_sharding": "scatter"}))
+    # comm_dtype × scatter used to be rejected here; the communication
+    # substrate made scatter the wire path for dtype narrowing, so the
+    # composition now constructs.
+    GossipTrainer(_gossip_sc(
+        gossip={"comm_dtype": "bfloat16", "update_sharding": "scatter"}))
     with pytest.raises(ValueError, match="no dense mixing"):
         GossipTrainer(_gossip_sc(
             gossip={"algorithm": "nocons", "update_sharding": "scatter"}))
